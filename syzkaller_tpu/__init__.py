@@ -1,0 +1,23 @@
+"""syzkaller_tpu: a TPU-native coverage-guided syscall fuzzing framework.
+
+A ground-up rebuild of the capabilities of syzkaller (reference: an early
+snapshot of google/syzkaller, see SURVEY.md) with the fuzzing hot loops --
+coverage signal-diff / corpus merge / corpus minimization and
+priority-table / choice-table sampling -- implemented as device-resident
+JAX/XLA array programs, and the surrounding runtime (executor, IPC,
+manager, VM fleet, crash intelligence) as native C++ + Python.
+
+Layer map (mirrors reference SURVEY.md section 1):
+  L1 execution   : syzkaller_tpu.ipc + syzkaller_tpu/native (C++ executor)
+  L2 type system : syzkaller_tpu.sys (+ descriptions/ DSL)
+  L3 core algos  : syzkaller_tpu.prog (tree logic) + syzkaller_tpu.ops (device)
+  L4 fuzz engine : syzkaller_tpu.fuzzer
+  L5 crash intel : syzkaller_tpu.report / .repro / .csource
+  L6 machines    : syzkaller_tpu.vm
+  L7 orchestrator: syzkaller_tpu.manager
+  L8 federation  : syzkaller_tpu.hub
+Device state    : syzkaller_tpu.models.fuzz_state (the flagship array program)
+Multi-chip      : syzkaller_tpu.parallel (mesh / shardings / collectives)
+"""
+
+__version__ = "0.1.0"

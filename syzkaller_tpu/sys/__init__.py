@@ -1,0 +1,28 @@
+"""L2 type system: syscall descriptions compiled into typed call tables.
+
+Capability parity with the reference sys/ package (sys/decl.go, sys/align.go)
+plus the offline toolchain (sysparser/, sysgen/): here the DSL is parsed and
+compiled at load time into a SyscallTable, no code generation step.
+"""
+
+from syzkaller_tpu.sys.types import (  # noqa: F401
+    Dir,
+    Type,
+    ResourceDesc,
+    ResourceType,
+    ConstType,
+    IntType,
+    FlagsType,
+    LenType,
+    ProcType,
+    VmaType,
+    BufferType,
+    PtrType,
+    ArrayType,
+    StructType,
+    UnionType,
+    Field,
+    Syscall,
+    is_pad,
+)
+from syzkaller_tpu.sys.table import SyscallTable, load_table  # noqa: F401

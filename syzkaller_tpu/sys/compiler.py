@@ -1,0 +1,442 @@
+"""Lowers the parsed DSL AST into typed Syscall objects.
+
+Capability parity with the reference sysgen (sysgen/sysgen.go:30-131,
+sysgen/syscallnr.go:19-102) except there is no code-generation step: the
+AST is compiled against a const map at load time.  Calls whose constants
+or syscall number are unknown for the target arch are skipped with a
+warning, as the reference does per-arch.
+
+Semantics grounded in the reference:
+  - type-expression forms: reference sys/README.md grammar section;
+  - struct padding/alignment: sys/align.go:34-72 (pad before misaligned
+    fields, trailing pad to struct alignment, varlen only at the tail of
+    non-packed structs);
+  - pseudo syscall numbering: sysgen/syscallnr.go:25-33 (1000001+);
+  - dir propagation: ptr[dir, X] applies dir to the pointee, struct
+    fields default to the enclosing dir unless they specify their own.
+"""
+
+from __future__ import annotations
+
+import re
+from dataclasses import dataclass, field
+
+from syzkaller_tpu.sys import types as T
+from syzkaller_tpu.sys.parser import (
+    Description,
+    FlagsDef,
+    ParseError,
+    Range,
+    StructDef,
+    SyscallDef,
+    TypeExpr,
+)
+from syzkaller_tpu.utils import log
+
+
+class CompileError(Exception):
+    pass
+
+
+_INT_NAMES = {n: (sz, n.endswith("be")) for n, sz in T._INT_SIZES.items()}
+
+_TEXT_KINDS = {
+    "x86_real": T.TextKind.X86_REAL, "x86_16": T.TextKind.X86_16,
+    "x86_32": T.TextKind.X86_32, "x86_64": T.TextKind.X86_64,
+    "arm64": T.TextKind.ARM64,
+}
+
+_DIRS = {"in": T.Dir.IN, "out": T.Dir.OUT, "inout": T.Dir.INOUT}
+
+
+@dataclass
+class CompiledDescription:
+    syscalls: list[T.Syscall] = field(default_factory=list)
+    resources: dict[str, T.ResourceDesc] = field(default_factory=dict)
+    structs: dict[str, T.Type] = field(default_factory=dict)
+    skipped: list[str] = field(default_factory=list)
+
+
+class Compiler:
+    def __init__(self, desc: Description, consts: dict[str, int],
+                 collect_only: bool = False):
+        """collect_only: don't abort a call on the first missing const --
+        substitute 0 and keep going, so `_missing` accumulates every
+        symbolic name the descriptions mention (used by the extractor)."""
+        self.desc = desc
+        self.consts = consts
+        self.collect_only = collect_only
+        self.resources: dict[str, T.ResourceDesc] = {}
+        # struct cache keyed by (name, dir): the same declaration used under
+        # ptr[in,...] and ptr[out,...] yields distinct type instances.
+        self._structs: dict[tuple[str, T.Dir], T.Type] = {}
+        self.skipped: list[str] = []
+        self._missing: set[str] = set()
+
+    # -- consts ------------------------------------------------------------
+
+    def _resolve_val(self, v, where: str) -> int | None:
+        if isinstance(v, int):
+            return v
+        assert isinstance(v, str)
+        if v in self.consts:
+            return self.consts[v]
+        self._missing.add(v)
+        return None
+
+    # -- resources ---------------------------------------------------------
+
+    def _resource(self, name: str) -> T.ResourceDesc | None:
+        if name in self.resources:
+            return self.resources[name]
+        rdef = self.desc.resources.get(name)
+        if rdef is None:
+            return None
+        if rdef.underlying in _INT_NAMES:
+            under = rdef.underlying
+            kind = (name,)
+        else:
+            parent = self._resource(rdef.underlying)
+            if parent is None:
+                raise CompileError(f"resource {name}: unknown underlying {rdef.underlying}")
+            under = parent.underlying
+            kind = parent.kind + (name,)
+        vals = []
+        for v in rdef.values:
+            rv = self._resolve_val(v, f"resource {name}")
+            if rv is None:
+                continue
+            vals.append(rv)
+        res = T.ResourceDesc(name=name, underlying=under, kind=kind, values=tuple(vals))
+        self.resources[name] = res
+        return res
+
+    def _resource_type(self, name: str, d: T.Dir, fld: str = "", opt: bool = False) -> T.ResourceType:
+        desc = self._resource(name)
+        assert desc is not None
+        size, be = _INT_NAMES[desc.underlying]
+        return T.ResourceType(name=name, fldname=fld, dir=d, optional=opt,
+                              type_size=size, big_endian=be, desc=desc)
+
+    # -- type expressions --------------------------------------------------
+
+    def compile_type(self, te: TypeExpr, d: T.Dir, fld: str = "") -> T.Type:
+        """Lower one type expression."""
+        name = te.name
+        opts = list(te.opts)
+        opt_flag = False
+        # "opt" may appear as the trailing option of any type.
+        if opts and isinstance(opts[-1], TypeExpr) and opts[-1].name == "opt" and not opts[-1].opts:
+            opt_flag = True
+            opts = opts[:-1]
+
+        def underlying(default=(8, False)):
+            """Consume a trailing intN option (struct field scalars)."""
+            if opts and isinstance(opts[-1], TypeExpr) and opts[-1].name in _INT_NAMES:
+                return _INT_NAMES[opts.pop().name]
+            return default
+
+        def need(n, what):
+            if len(opts) != n:
+                raise CompileError(f"{name}: expected {what}, got {te!r}")
+
+        if name in _INT_NAMES:
+            size, be = _INT_NAMES[name]
+            rb = re_ = 0
+            kind = T.IntKind.PLAIN
+            if opts:
+                o = opts[0]
+                if isinstance(o, Range):
+                    kind = T.IntKind.RANGE
+                    rb = self._opt_int(o.lo)
+                    re_ = self._opt_int(o.hi)
+                elif isinstance(o, int):
+                    kind = T.IntKind.RANGE
+                    rb = re_ = o
+                elif isinstance(o, TypeExpr) and o.name == "signalno":
+                    kind = T.IntKind.SIGNALNO
+                elif isinstance(o, TypeExpr) and o.name == "fileoff":
+                    kind = T.IntKind.FILEOFF
+                else:
+                    raise CompileError(f"bad int option {o!r} in {te!r}")
+            return T.IntType(name=name, fldname=fld, dir=d, optional=opt_flag,
+                             type_size=size, big_endian=be, kind=kind,
+                             range_begin=rb, range_end=re_)
+
+        if name == "const":
+            size, be = underlying()
+            need(1, "const[value]")
+            val = self._opt_int(opts[0])
+            return T.ConstType(name=name, fldname=fld, dir=d, optional=opt_flag,
+                               type_size=size, big_endian=be, val=val)
+
+        if name == "flags":
+            size, be = underlying()
+            need(1, "flags[name]")
+            fname = opts[0].name
+            fdef = self.desc.flags.get(fname)
+            if fdef is None:
+                raise CompileError(f"unknown flags {fname}")
+            vals = tuple(v for v in (self._resolve_val(x, f"flags {fname}") for x in fdef.values)
+                         if v is not None)
+            return T.FlagsType(name=fname, fldname=fld, dir=d, optional=opt_flag,
+                               type_size=size, big_endian=be, vals=vals)
+
+        if name in ("len", "bytesize", "bytesize2", "bytesize4", "bytesize8"):
+            size, be = underlying()
+            need(1, f"{name}[target]")
+            bs = {"len": 0, "bytesize": 1, "bytesize2": 2, "bytesize4": 4, "bytesize8": 8}[name]
+            return T.LenType(name=name, fldname=fld, dir=d, optional=opt_flag,
+                             type_size=size, big_endian=be,
+                             buf=opts[0].name, byte_size=bs)
+
+        if name == "fileoff":
+            size, be = underlying()
+            return T.IntType(name=name, fldname=fld, dir=d, optional=opt_flag,
+                             type_size=size, big_endian=be, kind=T.IntKind.FILEOFF)
+
+        if name == "proc":
+            need(3, "proc[type, start, per_proc]")
+            size, be = _INT_NAMES[opts[0].name]
+            return T.ProcType(name=name, fldname=fld, dir=d, optional=opt_flag,
+                              type_size=size, big_endian=be,
+                              values_start=self._opt_int(opts[1]),
+                              values_per_proc=self._opt_int(opts[2]))
+
+        if name in ("bool8", "bool16", "bool32", "bool64", "boolptr"):
+            size = {"bool8": 1, "bool16": 2, "bool32": 4, "bool64": 8,
+                    "boolptr": T.PTR_SIZE}[name]
+            return T.IntType(name=name, fldname=fld, dir=d, optional=opt_flag,
+                             type_size=size, kind=T.IntKind.RANGE,
+                             range_begin=0, range_end=1)
+
+        if name == "signalno":
+            return T.IntType(name=name, fldname=fld, dir=d, optional=opt_flag,
+                             type_size=4, kind=T.IntKind.SIGNALNO)
+
+        if name == "vma":
+            rb = re_ = 0
+            if opts:
+                o = opts[0]
+                if isinstance(o, Range):
+                    rb, re_ = self._opt_int(o.lo), self._opt_int(o.hi)
+                else:
+                    rb = re_ = self._opt_int(o)
+            return T.VmaType(name=name, fldname=fld, dir=d, optional=opt_flag,
+                             range_begin=rb, range_end=re_)
+
+        if name == "buffer":
+            need(1, "buffer[dir]")
+            bd = _DIRS[opts[0].name]
+            blob = T.BufferType(name="blob", dir=bd, kind=T.BufferKind.BLOB_RAND)
+            return T.PtrType(name=name, fldname=fld, dir=bd, optional=opt_flag, elem=blob)
+
+        if name == "string" or name == "strconst":
+            vals: tuple[str, ...] = ()
+            str_len = 0
+            if opts:
+                o = opts[0]
+                if isinstance(o, str):
+                    vals = (o,)
+                elif isinstance(o, TypeExpr):
+                    sf = self.desc.strflags.get(o.name)
+                    if sf is None:
+                        raise CompileError(f"unknown string flags {o.name}")
+                    vals = tuple(sf.values)
+                if len(opts) > 1:
+                    str_len = self._opt_int(opts[1])
+            return T.BufferType(name=name, fldname=fld, dir=d, optional=opt_flag,
+                                kind=T.BufferKind.STRING, values=vals, str_length=str_len)
+
+        if name == "filename":
+            return T.BufferType(name=name, fldname=fld, dir=d, optional=opt_flag,
+                                kind=T.BufferKind.FILENAME)
+
+        if name == "text":
+            need(1, "text[kind]")
+            return T.BufferType(name=name, fldname=fld, dir=d, optional=opt_flag,
+                                kind=T.BufferKind.TEXT, text_kind=_TEXT_KINDS[opts[0].name])
+
+        if name == "array":
+            if not opts:
+                raise CompileError(f"array needs element type: {te!r}")
+            elem = self.compile_type(opts[0], d, "")
+            kind, rb, re_ = T.ArrayKind.RAND_LEN, 0, 0
+            if len(opts) > 1:
+                o = opts[1]
+                if isinstance(o, Range):
+                    kind, rb, re_ = T.ArrayKind.RANGE_LEN, self._opt_int(o.lo), self._opt_int(o.hi)
+                else:
+                    n = self._opt_int(o)
+                    kind, rb, re_ = T.ArrayKind.RANGE_LEN, n, n
+            # Special case: array[int8] == random blob (reference semantics).
+            if isinstance(elem, T.IntType) and elem.type_size == 1 and kind == T.ArrayKind.RAND_LEN:
+                return T.BufferType(name=name, fldname=fld, dir=d, optional=opt_flag,
+                                    kind=T.BufferKind.BLOB_RAND)
+            if isinstance(elem, T.IntType) and elem.type_size == 1 and kind == T.ArrayKind.RANGE_LEN:
+                return T.BufferType(name=name, fldname=fld, dir=d, optional=opt_flag,
+                                    kind=T.BufferKind.BLOB_RANGE, range_begin=rb, range_end=re_)
+            return T.ArrayType(name=name, fldname=fld, dir=d, optional=opt_flag,
+                               elem=elem, kind=kind, range_begin=rb, range_end=re_)
+
+        if name == "ptr":
+            need(2, "ptr[dir, type]")
+            pd = _DIRS[opts[0].name]
+            elem = self.compile_type(opts[1], pd, "")
+            return T.PtrType(name=name, fldname=fld, dir=pd, optional=opt_flag, elem=elem)
+
+        # Named references: resource, struct/union, string-flags shorthand.
+        if name in self.desc.resources:
+            return self._resource_type(name, d, fld, opt_flag)
+        if name in self.desc.structs:
+            st = self._struct(name, d)
+            return st.with_field(fld) if fld else st
+        raise CompileError(f"unknown type {te!r}")
+
+    def _opt_int(self, o) -> int:
+        if isinstance(o, int):
+            return o
+        if isinstance(o, TypeExpr) and not o.opts:
+            v = self._resolve_val(o.name, "type option")
+            if v is None:
+                if self.collect_only:
+                    return 0
+                raise _MissingConst(o.name)
+            return v
+        raise CompileError(f"expected integer option, got {o!r}")
+
+    # -- structs -----------------------------------------------------------
+
+    def _struct(self, name: str, d: T.Dir) -> T.Type:
+        key = (name, d)
+        if key in self._structs:
+            return self._structs[key]
+        sdef = self.desc.structs[name]
+        if sdef.is_union:
+            u = T.UnionType(name=name, dir=d)
+            self._structs[key] = u
+            try:
+                u.options = tuple(
+                    self.compile_type(fte, d, fname)
+                    for fname, fte in sdef.fields
+                )
+            except _MissingConst:
+                del self._structs[key]  # don't cache a partially-built union
+                raise
+            u.varlen = "varlen" in sdef.attrs
+            return u
+        st = T.StructType(name=name, dir=d)
+        self._structs[key] = st
+        try:
+            st.fields = tuple(
+                self.compile_type(fte, d, fname)
+                for fname, fte in sdef.fields
+            )
+        except _MissingConst:
+            del self._structs[key]  # don't cache a partially-built struct
+            raise
+        for a in sdef.attrs:
+            if a == "packed":
+                st.packed = True
+            elif m := re.fullmatch(r"align_(\d+)", a):
+                st.align_attr = int(m.group(1))
+            else:
+                raise CompileError(f"struct {name}: unknown attribute {a}")
+        self._pad_struct(st)
+        return st
+
+    def _pad_struct(self, st: T.StructType) -> None:
+        """Insert alignment padding (reference sys/align.go:34-72)."""
+        if st.padded:
+            return
+        st.padded = True
+        if st.packed:
+            return
+        out: list[T.Type] = []
+        off = 0
+        align = 0
+        varlen = False
+        for i, f in enumerate(st.fields):
+            a = f.align()
+            align = max(align, a)
+            if off % a != 0:
+                pad = a - off % a
+                off += pad
+                out.append(_make_pad(pad))
+            out.append(f)
+            if f.is_varlen():
+                varlen = True
+                # A varlen field anywhere but the tail makes later offsets
+                # dynamic, so static padding would be wrong; the reference
+                # rejects this shape too (sys/align.go:58-60).
+                if i != len(st.fields) - 1:
+                    raise CompileError(f"struct {st.name}: varlen field {f.field_name()} "
+                                       f"not at the end")
+            if not varlen:
+                off += f.size()
+        if align and off % align != 0 and not varlen:
+            out.append(_make_pad(align - off % align))
+        st.fields = tuple(out)
+
+    # -- syscalls ----------------------------------------------------------
+
+    def compile(self) -> CompiledDescription:
+        out = CompiledDescription()
+        pseudo_nr: dict[str, int] = {}
+        for sdef in self.desc.syscalls:
+            call_name = sdef.name.split("$", 1)[0]
+            if call_name.startswith("syz_"):
+                nr = pseudo_nr.setdefault(call_name, T.PSEUDO_NR_BASE + 1 + len(pseudo_nr))
+            else:
+                nr = self.consts.get(f"__NR_{call_name}")
+                if nr is None:
+                    self.skipped.append(sdef.name)
+                    continue
+            try:
+                args = tuple(
+                    self.compile_type(ate, T.Dir.IN, aname)
+                    for aname, ate in sdef.args
+                )
+                ret = None
+                if sdef.ret is not None:
+                    if sdef.ret not in self.desc.resources:
+                        raise CompileError(
+                            f"{sdef.name}: return type {sdef.ret} is not a resource")
+                    ret = self._resource_type(sdef.ret, T.Dir.OUT)
+            except _MissingConst as e:
+                self.skipped.append(f"{sdef.name} (missing const {e})")
+                continue
+            out.syscalls.append(T.Syscall(
+                id=len(out.syscalls), nr=nr, name=sdef.name,
+                call_name=call_name, args=args, ret=ret))
+        out.resources = dict(self.resources)
+        out.structs = {k[0]: v for k, v in self._structs.items() if k[1] == T.Dir.IN}
+        out.skipped = self.skipped
+        if self._missing:
+            log.logf(2, "sys: %d unresolved consts: %s", len(self._missing),
+                     ", ".join(sorted(self._missing)[:10]))
+        return out
+
+
+class _MissingConst(Exception):
+    pass
+
+
+def _make_pad(size: int) -> T.ConstType:
+    return T.ConstType(name="pad", type_size=size, val=0, pad=True)
+
+
+def parse_const_file(text: str) -> dict[str, int]:
+    """Parse a `.const` file: `NAME = value` lines, '#' comments."""
+    consts: dict[str, int] = {}
+    for line in text.splitlines():
+        line = line.split("#", 1)[0].strip()
+        if not line:
+            continue
+        name, _, val = line.partition("=")
+        consts[name.strip()] = int(val.strip(), 0)
+    return consts
+
+
+def compile_descriptions(desc: Description, consts: dict[str, int]) -> CompiledDescription:
+    return Compiler(desc, consts).compile()

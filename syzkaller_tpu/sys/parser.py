@@ -1,0 +1,377 @@
+"""Parser for the declarative syscall-description DSL.
+
+Accepts the same grammar as the reference toolchain (sysparser/parser.go,
+grammar documented in reference sys/README.md:17-120): syscalls with
+typed args, resources with kind hierarchies and special values, flag
+sets (integer and string), structs `{...}` with packed/align_N attrs,
+unions `[...]` with varlen attr, plus `include` and `define` directives
+consumed by the const extractor.
+
+Output is a plain AST (no const resolution, no type objects); the
+compiler (syzkaller_tpu/sys/compiler.py) lowers it against a const map.
+"""
+
+from __future__ import annotations
+
+import re
+from dataclasses import dataclass, field
+
+
+class ParseError(Exception):
+    def __init__(self, filename: str, line: int, msg: str):
+        super().__init__(f"{filename}:{line}: {msg}")
+        self.filename, self.line, self.msg = filename, line, msg
+
+
+# ---------------------------------------------------------------------------
+# AST
+
+
+@dataclass
+class TypeExpr:
+    """`typename[opt, opt, ...]`; opts are TypeExpr | int | str-literal | Range."""
+    name: str
+    opts: list = field(default_factory=list)
+
+    def __repr__(self):
+        return f"{self.name}[{', '.join(map(repr, self.opts))}]" if self.opts else self.name
+
+
+@dataclass
+class Range:
+    lo: "int | str"
+    hi: "int | str"
+
+
+@dataclass
+class SyscallDef:
+    name: str
+    args: list[tuple[str, TypeExpr]]
+    ret: str | None
+    filename: str = ""
+    line: int = 0
+
+
+@dataclass
+class ResourceDef:
+    name: str
+    underlying: str
+    values: list  # int | identifier str
+    filename: str = ""
+    line: int = 0
+
+
+@dataclass
+class FlagsDef:
+    name: str
+    values: list  # int | identifier str
+    line: int = 0
+
+
+@dataclass
+class StrFlagsDef:
+    name: str
+    values: list[str]
+    line: int = 0
+
+
+@dataclass
+class StructDef:
+    name: str
+    fields: list[tuple[str, TypeExpr]]
+    is_union: bool
+    attrs: list[str] = field(default_factory=list)
+    filename: str = ""
+    line: int = 0
+
+
+@dataclass
+class Description:
+    syscalls: list[SyscallDef] = field(default_factory=list)
+    resources: dict[str, ResourceDef] = field(default_factory=dict)
+    structs: dict[str, StructDef] = field(default_factory=dict)
+    flags: dict[str, FlagsDef] = field(default_factory=dict)
+    strflags: dict[str, StrFlagsDef] = field(default_factory=dict)
+    includes: list[str] = field(default_factory=list)
+    defines: list[tuple[str, str]] = field(default_factory=list)
+    unnamed: dict[str, TypeExpr] = field(default_factory=dict)  # auto-named inline types
+
+    def merge(self, other: "Description") -> None:
+        self.syscalls.extend(other.syscalls)
+        for attr in ("resources", "structs", "flags", "strflags"):
+            mine, theirs = getattr(self, attr), getattr(other, attr)
+            for k, v in theirs.items():
+                if k in mine:
+                    raise ParseError(getattr(v, "filename", "?"), getattr(v, "line", 0),
+                                     f"duplicate definition of {k}")
+                mine[k] = v
+        self.includes.extend(other.includes)
+        self.defines.extend(other.defines)
+
+
+# ---------------------------------------------------------------------------
+# Tokenized scanning of a single line
+
+
+class _Scanner:
+    """Character scanner for one logical line."""
+
+    PUNCT = set("()[]{}=,:")
+
+    def __init__(self, text: str, filename: str, line: int):
+        self.text = text
+        self.pos = 0
+        self.filename = filename
+        self.line = line
+
+    def err(self, msg: str):
+        raise ParseError(self.filename, self.line, f"{msg} (at {self.text[self.pos:self.pos+20]!r})")
+
+    def ws(self):
+        while self.pos < len(self.text) and self.text[self.pos] in " \t":
+            self.pos += 1
+
+    def peek(self) -> str:
+        self.ws()
+        return self.text[self.pos] if self.pos < len(self.text) else ""
+
+    def eat(self, ch: str):
+        if self.peek() != ch:
+            self.err(f"expected {ch!r}")
+        self.pos += 1
+
+    def at_end(self) -> bool:
+        return self.peek() == ""
+
+    def ident(self) -> str:
+        self.ws()
+        start = self.pos
+        while self.pos < len(self.text) and (self.text[self.pos].isalnum() or self.text[self.pos] in "_$"):
+            self.pos += 1
+        if start == self.pos:
+            self.err("expected identifier")
+        return self.text[start:self.pos]
+
+    def maybe_number(self):
+        """Parse int literal (dec/hex/neg) or single-quoted char; None if not numeric."""
+        self.ws()
+        start = self.pos
+        t = self.text
+        if self.pos < len(t) and t[self.pos] == "'":
+            if self.pos + 2 < len(t) and t[self.pos + 2] == "'":
+                v = ord(t[self.pos + 1])
+                self.pos += 3
+                return v
+            self.err("bad char literal")
+        neg = False
+        if self.pos < len(t) and t[self.pos] == "-":
+            neg = True
+            self.pos += 1
+        if not (self.pos < len(t) and t[self.pos].isdigit()):
+            self.pos = start
+            return None
+        if t[self.pos:self.pos + 2].lower() == "0x":
+            self.pos += 2
+            s = self.pos
+            while self.pos < len(t) and t[self.pos] in "0123456789abcdefABCDEF":
+                self.pos += 1
+            v = int(t[s:self.pos], 16)
+        else:
+            s = self.pos
+            while self.pos < len(t) and t[self.pos].isdigit():
+                self.pos += 1
+            v = int(t[s:self.pos])
+        # An identifier like 9p2000 would start with a digit -- the DSL
+        # forbids that, so digits followed by ident chars is an error.
+        if self.pos < len(t) and (t[self.pos].isalpha() or t[self.pos] == "_"):
+            self.err("identifier starts with digit")
+        return -v if neg else v
+
+    def string(self) -> str:
+        self.ws()
+        if self.peek() != '"':
+            self.err("expected string literal")
+        self.pos += 1
+        start = self.pos
+        while self.pos < len(self.text) and self.text[self.pos] != '"':
+            self.pos += 1
+        if self.pos >= len(self.text):
+            self.err("unterminated string")
+        s = self.text[start:self.pos]
+        self.pos += 1
+        return s
+
+
+def _parse_type_expr(sc: _Scanner) -> TypeExpr:
+    name = sc.ident()
+    te = TypeExpr(name)
+    if sc.peek() == "[":
+        sc.eat("[")
+        if sc.peek() != "]":
+            while True:
+                te.opts.append(_parse_type_opt(sc))
+                if sc.peek() != ",":
+                    break
+                sc.eat(",")
+        sc.eat("]")
+    return te
+
+
+def _parse_type_opt(sc: _Scanner):
+    if sc.peek() == '"':
+        return sc.string()
+    num = sc.maybe_number()
+    if num is not None:
+        if sc.peek() == ":":
+            sc.eat(":")
+            hi = sc.maybe_number()
+            if hi is None:
+                sc.err("expected range end")
+            return Range(num, hi)
+        return num
+    sub = _parse_type_expr(sc)
+    # `A:B` range with symbolic endpoints (e.g. vma[2-4] uses '-'? no: 2:4).
+    if not sub.opts and sc.peek() == ":":
+        sc.eat(":")
+        hi = sc.maybe_number()
+        if hi is None:
+            hi = sc.ident()
+        return Range(sub.name, hi)
+    return sub
+
+
+# ---------------------------------------------------------------------------
+# File-level parsing
+
+
+def _strip_comment(line: str) -> str:
+    """Strip a '#' comment, but not inside string literals ('#' is a valid
+    char in string values, e.g. device-name templates like "mouse#")."""
+    in_str = False
+    for i, ch in enumerate(line):
+        if ch == '"':
+            in_str = not in_str
+        elif ch == "#" and not in_str:
+            return line[:i]
+    return line
+
+
+_INCLUDE_RE = re.compile(r"^include\s*<([^>]+)>\s*$")
+_DEFINE_RE = re.compile(r"^define\s+(\w+)\s+(.*)$")
+
+
+def parse(text: str, filename: str = "<string>") -> Description:
+    desc = Description()
+    lines = text.split("\n")
+    i = 0
+    while i < len(lines):
+        raw = lines[i]
+        line_no = i + 1
+        i += 1
+        line = _strip_comment(raw).rstrip()
+        if not line.strip():
+            continue
+        sc = _Scanner(line, filename, line_no)
+        # Directives.
+        stripped = line.strip()
+        if m := _INCLUDE_RE.match(stripped):
+            desc.includes.append(m.group(1).strip())
+            continue
+        if m := _DEFINE_RE.match(stripped):
+            desc.defines.append((m.group(1), m.group(2).strip()))
+            continue
+        if stripped.startswith("resource "):
+            sc.pos = line.index("resource ") + len("resource ")
+            name = sc.ident()
+            sc.eat("[")
+            under = sc.ident()
+            sc.eat("]")
+            vals = []
+            if sc.peek() == ":":
+                sc.eat(":")
+                while True:
+                    v = sc.maybe_number()
+                    vals.append(v if v is not None else sc.ident())
+                    if sc.peek() != ",":
+                        break
+                    sc.eat(",")
+            desc.resources[name] = ResourceDef(name, under, vals, filename, line_no)
+            continue
+        # Struct/union body start:  name { ... }   /  name [ ... ]
+        name = sc.ident()
+        ch = sc.peek()
+        if ch in "{[":
+            is_union = ch == "["
+            close = "}" if not is_union else "]"
+            flds: list[tuple[str, TypeExpr]] = []
+            while True:
+                if i >= len(lines):
+                    raise ParseError(filename, line_no, f"unterminated {'union' if is_union else 'struct'} {name}")
+                body = _strip_comment(lines[i]).strip()
+                body_line = i + 1
+                i += 1
+                if not body:
+                    continue
+                if body.startswith(close):
+                    attrs = []
+                    rest = body[1:].strip()
+                    if rest.startswith("[") and rest.endswith("]"):
+                        attrs = [a.strip() for a in rest[1:-1].split(",")]
+                    desc.structs[name] = StructDef(name, flds, is_union, attrs, filename, line_no)
+                    break
+                fsc = _Scanner(body, filename, body_line)
+                fname = fsc.ident()
+                ftype = _parse_type_expr(fsc)
+                if not fsc.at_end():
+                    fsc.err("trailing junk after field")
+                flds.append((fname, ftype))
+            continue
+        if ch == "(":
+            # Syscall definition.
+            sc.eat("(")
+            args: list[tuple[str, TypeExpr]] = []
+            if sc.peek() != ")":
+                while True:
+                    aname = sc.ident()
+                    atype = _parse_type_expr(sc)
+                    args.append((aname, atype))
+                    if sc.peek() != ",":
+                        break
+                    sc.eat(",")
+            sc.eat(")")
+            ret = None
+            if not sc.at_end():
+                ret = sc.ident()
+                if not sc.at_end():
+                    sc.err("trailing junk after return type")
+            desc.syscalls.append(SyscallDef(name, args, ret, filename, line_no))
+            continue
+        if ch == "=":
+            sc.eat("=")
+            if sc.peek() == '"':
+                vals_s = [sc.string()]
+                while sc.peek() == ",":
+                    sc.eat(",")
+                    vals_s.append(sc.string())
+                if not sc.at_end():
+                    sc.err("trailing junk after string flags")
+                desc.strflags[name] = StrFlagsDef(name, vals_s, line_no)
+            else:
+                vals = []
+                while True:
+                    v = sc.maybe_number()
+                    vals.append(v if v is not None else sc.ident())
+                    if sc.peek() != ",":
+                        break
+                    sc.eat(",")
+                if not sc.at_end():
+                    sc.err("trailing junk after flags")
+                desc.flags[name] = FlagsDef(name, vals, line_no)
+            continue
+        sc.err(f"cannot parse line starting with {name!r}")
+    return desc
+
+
+def parse_file(path: str) -> Description:
+    with open(path, "r") as f:
+        return parse(f.read(), path)

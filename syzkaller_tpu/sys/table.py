@@ -1,0 +1,154 @@
+"""The compiled syscall table and resource/call closure queries.
+
+Capability parity with the reference's generated global tables and
+query helpers (sys/decl.go:358-555): Calls/CallMap/CallID, resource
+constructor discovery, resource compatibility, and the
+transitively-enabled-calls fixpoint.
+"""
+
+from __future__ import annotations
+
+import functools
+import glob
+import os
+from dataclasses import dataclass, field
+
+from syzkaller_tpu.sys import types as T
+from syzkaller_tpu.sys import parser, compiler
+from syzkaller_tpu.utils import log
+
+DESC_DIR = os.path.join(os.path.dirname(__file__), "..", "descriptions")
+
+
+@dataclass
+class SyscallTable:
+    calls: list[T.Syscall]
+    resources: dict[str, T.ResourceDesc]
+    structs: dict[str, T.Type]
+    skipped: list[str] = field(default_factory=list)
+
+    def __post_init__(self):
+        self.call_map: dict[str, T.Syscall] = {c.name: c for c in self.calls}
+        self._ctors: dict[str, list[T.Syscall]] = {}
+        for name, res in self.resources.items():
+            self._ctors[name] = self._find_ctors(res.kind, precise=False)
+
+    @property
+    def count(self) -> int:
+        return len(self.calls)
+
+    def __getitem__(self, name: str) -> T.Syscall:
+        return self.call_map[name]
+
+    # -- resource constructors (reference sys/decl.go:358-393) -------------
+
+    def _find_ctors(self, kind: tuple[str, ...], precise: bool) -> list[T.Syscall]:
+        metas = []
+        for call in self.calls:
+            found = []
+
+            def visit(t: T.Type):
+                if (isinstance(t, T.ResourceType) and t.dir != T.Dir.IN
+                        and T.kind_compatible(kind, t.desc.kind, precise)):
+                    found.append(t)
+
+            T.foreach_type(call, visit)
+            if found:
+                metas.append(call)
+        return metas
+
+    def resource_constructors(self, name: str) -> list[T.Syscall]:
+        return self._ctors.get(name, [])
+
+    def is_compatible_resource(self, dst: str, src: str) -> bool:
+        return T.kind_compatible(self.resources[dst].kind, self.resources[src].kind, False)
+
+    # -- call closure (reference sys/decl.go:430-485) -----------------------
+
+    def input_resources(self, call: T.Syscall) -> list[T.ResourceType]:
+        out: list[T.ResourceType] = []
+
+        def visit(t: T.Type):
+            if isinstance(t, T.ResourceType) and t.dir != T.Dir.OUT and not t.optional:
+                out.append(t)
+
+        T.foreach_type(call, visit)
+        return out
+
+    def transitively_enabled_calls(
+            self, enabled: "set[T.Syscall] | None" = None) -> set[T.Syscall]:
+        """Largest subset of `enabled` where every input resource of every
+        call can be created by some other call in the subset (fixpoint)."""
+        supported = set(self.calls if enabled is None else enabled)
+        while True:
+            n = len(supported)
+            for call in list(supported):
+                ok = True
+                for res in self.input_resources(call):
+                    if not any(
+                        ctor in supported
+                        for ctor in self._find_ctors_cached(res.desc.kind)
+                    ):
+                        ok = False
+                        break
+                if not ok:
+                    supported.discard(call)
+            if len(supported) == n:
+                return supported
+
+    @functools.lru_cache(maxsize=None)
+    def _find_ctors_cached(self, kind: tuple[str, ...]) -> tuple[T.Syscall, ...]:
+        return tuple(self._find_ctors(kind, precise=True))
+
+    def __hash__(self):  # for lru_cache on methods
+        return id(self)
+
+
+_cache: dict[tuple, SyscallTable] = {}
+
+
+def load_table(files: "list[str] | None" = None, arch: str = "amd64",
+               desc_dir: str | None = None) -> SyscallTable:
+    """Parse + compile description files into a SyscallTable.
+
+    files: description file names (e.g. ["test.txt"]); None = all *.txt
+    under the descriptions dir (searched recursively).
+    """
+    desc_dir = os.path.abspath(desc_dir or DESC_DIR)
+    if files is None:
+        paths = sorted(glob.glob(os.path.join(desc_dir, "**", "*.txt"), recursive=True))
+    else:
+        paths = []
+        for f in files:
+            if os.path.sep in f or os.path.exists(f):
+                paths.append(f)
+            else:
+                hits = glob.glob(os.path.join(desc_dir, "**", f), recursive=True)
+                if not hits:
+                    raise FileNotFoundError(f"description file {f} not found under {desc_dir}")
+                paths.extend(sorted(hits))
+    key = (tuple(paths), arch)
+    if key in _cache:
+        return _cache[key]
+
+    desc = parser.Description()
+    for p in paths:
+        desc.merge(parser.parse_file(p))
+
+    consts: dict[str, int] = {}
+    const_path = os.path.join(desc_dir, "consts", f"{arch}.const")
+    if os.path.exists(const_path):
+        with open(const_path) as f:
+            consts = compiler.parse_const_file(f.read())
+
+    compiled = compiler.compile_descriptions(desc, consts)
+    table = SyscallTable(
+        calls=compiled.syscalls,
+        resources=compiled.resources,
+        structs=compiled.structs,
+        skipped=compiled.skipped,
+    )
+    if compiled.skipped:
+        log.logf(1, "sys: skipped %d calls unsupported on %s", len(compiled.skipped), arch)
+    _cache[key] = table
+    return table

@@ -1,0 +1,383 @@
+"""The syscall type system: 12 type kinds, resources, alignment.
+
+Capability parity with the reference type system (sys/decl.go:30-356):
+Resource, Buffer (blob/string/filename/text), Vma, Len/Bytesize, Flags,
+Const, Int (plain/signalno/fileoff/range), Proc, Array, Ptr, Struct,
+Union — plus the resource kind-hierarchy compatibility relation
+(sys/decl.go:396-429) and struct padding/alignment (sys/align.go:6-80).
+
+Design differences from the reference: types are immutable dataclasses
+produced by the DSL compiler (syzkaller_tpu/sys/compiler.py); there is no
+generated per-arch Go file — the table is built at load time and cached.
+"""
+
+from __future__ import annotations
+
+import enum
+from dataclasses import dataclass, field, replace
+from typing import Optional
+
+PTR_SIZE = 8
+PAGE_SIZE = 4 << 10
+
+
+class Dir(enum.IntEnum):
+    IN = 0
+    OUT = 1
+    INOUT = 2
+
+
+class IntKind(enum.IntEnum):
+    PLAIN = 0
+    SIGNALNO = 1
+    FILEOFF = 2
+    RANGE = 3
+
+
+class BufferKind(enum.IntEnum):
+    BLOB_RAND = 0
+    BLOB_RANGE = 1
+    STRING = 2
+    FILENAME = 3
+    TEXT = 4
+
+
+class TextKind(enum.IntEnum):
+    X86_REAL = 0
+    X86_16 = 1
+    X86_32 = 2
+    X86_64 = 3
+    ARM64 = 4
+
+
+class ArrayKind(enum.IntEnum):
+    RAND_LEN = 0
+    RANGE_LEN = 1
+
+
+_INT_SIZES = {
+    "int8": 1, "int16": 2, "int32": 4, "int64": 8, "intptr": PTR_SIZE,
+    "int16be": 2, "int32be": 4, "int64be": 8, "intptrbe": PTR_SIZE,
+}
+
+
+def kind_compatible(dst: tuple[str, ...], src: tuple[str, ...],
+                    precise: bool = False) -> bool:
+    """Resource kind-hierarchy compatibility (reference sys/decl.go:412-429):
+    a resource of kind `src` can be passed where `dst` is expected iff the
+    shorter chain is a prefix of the longer.  precise forbids passing a less
+    specialized resource (dst longer than src)."""
+    if len(dst) > len(src):
+        if precise:
+            return False
+        dst = dst[: len(src)]
+    if len(src) > len(dst):
+        src = src[: len(dst)]
+    return dst == src
+
+
+@dataclass(eq=False)
+class Type:
+    """Base of all argument/field types.
+
+    name   -- the type name as written in the DSL (e.g. "int32", "fd").
+    fldname-- field/argument name this type instance labels (may be "").
+    dir    -- data direction relative to the kernel.
+    optional -- the "opt" type-option: argument may be null/absent.
+    """
+    name: str = ""
+    fldname: str = ""
+    dir: Dir = Dir.IN
+    optional: bool = False
+
+    def size(self) -> int:
+        raise NotImplementedError(self.__class__.__name__)
+
+    def align(self) -> int:
+        raise NotImplementedError(self.__class__.__name__)
+
+    def default(self) -> int:
+        return 0
+
+    def is_varlen(self) -> bool:
+        return False
+
+    def field_name(self) -> str:
+        return self.fldname or self.name
+
+    def with_field(self, fldname: str):
+        return replace(self, fldname=fldname)
+
+    def with_dir(self, d: Dir):
+        return replace(self, dir=d)
+
+
+@dataclass(eq=False)
+class _IntCommon(Type):
+    """Shared shape of all scalar integer-like types."""
+    type_size: int = 8
+    big_endian: bool = False
+
+    def size(self) -> int:
+        return self.type_size
+
+    def align(self) -> int:
+        return self.type_size
+
+
+@dataclass(eq=False)
+class ResourceDesc:
+    """A declared resource: kind hierarchy + special values.
+
+    kind is the specialization chain from most general to this resource,
+    e.g. sock_unix -> ("fd", "sock", "sock_unix").  Two resources are
+    compatible if one's chain is a prefix of the other's
+    (reference sys/decl.go:412-429).
+    """
+    name: str
+    underlying: str          # int8/int16/int32/int64/intptr
+    kind: tuple[str, ...]
+    values: tuple[int, ...]  # special values; first is the default
+
+    def compatible_with(self, dst: "ResourceDesc", precise: bool = False) -> bool:
+        return kind_compatible(dst.kind, self.kind, precise)
+
+
+@dataclass(eq=False)
+class ResourceType(_IntCommon):
+    desc: ResourceDesc = None  # type: ignore[assignment]
+
+    def default(self) -> int:
+        return self.desc.values[0] if self.desc.values else 0
+
+    def special_values(self) -> tuple[int, ...]:
+        return self.desc.values or (0,)
+
+
+@dataclass(eq=False)
+class ConstType(_IntCommon):
+    val: int = 0
+    pad: bool = False  # alignment padding inserted by the align pass
+
+    def default(self) -> int:
+        return self.val
+
+
+@dataclass(eq=False)
+class IntType(_IntCommon):
+    kind: IntKind = IntKind.PLAIN
+    range_begin: int = 0
+    range_end: int = 0
+
+
+@dataclass(eq=False)
+class FlagsType(_IntCommon):
+    vals: tuple[int, ...] = ()
+
+
+@dataclass(eq=False)
+class LenType(_IntCommon):
+    """Length of another field.
+
+    byte_size == 0: element count (len[] on arrays) or byte length otherwise;
+    byte_size == N: byte length divided by N (bytesize/bytesize2/4/8).
+    buf is the referenced field name, or "parent" for the enclosing struct.
+    """
+    buf: str = ""
+    byte_size: int = 0
+
+
+@dataclass(eq=False)
+class ProcType(_IntCommon):
+    """Per-process disjoint value ranges (ports, ipc ids)."""
+    values_start: int = 0
+    values_per_proc: int = 1
+
+    def default(self) -> int:
+        return self.values_start
+
+
+@dataclass(eq=False)
+class VmaType(Type):
+    """Pointer to a whole-page memory region."""
+    range_begin: int = 0  # pages; 0,0 = unconstrained
+    range_end: int = 0
+
+    def size(self) -> int:
+        return PTR_SIZE
+
+    def align(self) -> int:
+        return PTR_SIZE
+
+
+@dataclass(eq=False)
+class BufferType(Type):
+    kind: BufferKind = BufferKind.BLOB_RAND
+    range_begin: int = 0          # BLOB_RANGE
+    range_end: int = 0
+    text_kind: TextKind = TextKind.X86_64
+    values: tuple[str, ...] = ()  # STRING constants
+    str_length: int = 0           # pad STRING values with NUL to this length
+
+    def fixed_size(self) -> "int | None":
+        """Byte size if statically known: fixed-range blobs and padded or
+        uniform-value strings; None for random blobs/filenames/text."""
+        if self.kind == BufferKind.BLOB_RANGE and self.range_begin == self.range_end:
+            return self.range_begin
+        if self.kind == BufferKind.STRING:
+            if self.str_length:
+                return self.str_length
+            if self.values and len({len(v) for v in self.values}) == 1:
+                return len(self.values[0]) + 1  # NUL-terminated
+        return None
+
+    def size(self) -> int:
+        sz = self.fixed_size()
+        if sz is None:
+            raise ValueError(f"buffer {self.name} is varlen")
+        return sz
+
+    def align(self) -> int:
+        return 1
+
+    def is_varlen(self) -> bool:
+        return self.fixed_size() is None
+
+
+@dataclass(eq=False)
+class PtrType(Type):
+    elem: Optional[Type] = None  # None = opaque buffer pointer ("buffer" DSL type)
+
+    def size(self) -> int:
+        return PTR_SIZE
+
+    def align(self) -> int:
+        return PTR_SIZE
+
+
+@dataclass(eq=False)
+class ArrayType(Type):
+    elem: Type = None  # type: ignore[assignment]
+    kind: ArrayKind = ArrayKind.RAND_LEN
+    range_begin: int = 0
+    range_end: int = 0
+
+    def is_fixed(self) -> bool:
+        return self.kind == ArrayKind.RANGE_LEN and self.range_begin == self.range_end
+
+    def size(self) -> int:
+        if self.is_fixed() and not self.elem.is_varlen():
+            return self.range_begin * self.elem.size()
+        raise ValueError(f"array {self.name} is varlen")
+
+    def align(self) -> int:
+        return self.elem.align()
+
+    def is_varlen(self) -> bool:
+        return not (self.is_fixed() and not self.elem.is_varlen())
+
+
+@dataclass(eq=False)
+class StructType(Type):
+    fields: tuple[Type, ...] = ()
+    packed: bool = False
+    align_attr: int = 0
+    padded: bool = False  # set once the align pass has inserted padding
+
+    def size(self) -> int:
+        if self.is_varlen():
+            raise ValueError(f"struct {self.name} is varlen")
+        return sum(f.size() for f in self.fields)
+
+    def align(self) -> int:
+        if self.align_attr:
+            return self.align_attr
+        if self.packed:
+            return 1
+        return max((f.align() for f in self.fields), default=1)
+
+    def is_varlen(self) -> bool:
+        return any(f.is_varlen() for f in self.fields)
+
+
+@dataclass(eq=False)
+class UnionType(Type):
+    options: tuple[Type, ...] = ()
+    varlen: bool = False
+
+    def size(self) -> int:
+        if self.varlen:
+            raise ValueError(f"union {self.name} is varlen")
+        return max(o.size() for o in self.options)
+
+    def align(self) -> int:
+        return max(o.align() for o in self.options)
+
+    def is_varlen(self) -> bool:
+        return self.varlen or any(o.is_varlen() for o in self.options)
+
+
+# A named struct/union field is just a Type with fldname set.
+Field = Type
+
+
+def is_pad(t: Type) -> bool:
+    return isinstance(t, ConstType) and t.pad
+
+
+@dataclass
+class Syscall:
+    """One syscall (or $variant) in the compiled table.
+
+    id -- dense index into the table (choice-table row).
+    nr -- kernel syscall number; pseudo syz_* calls get PSEUDO_NR_BASE+.
+    call_name -- name before '$' (what the kernel sees).
+    """
+    id: int
+    nr: int
+    name: str
+    call_name: str
+    args: tuple[Type, ...]
+    ret: Optional[ResourceType] = None
+
+    def __hash__(self):
+        return hash((self.name, self.id))
+
+    def __repr__(self):
+        return f"<Syscall {self.name}#{self.id}>"
+
+
+PSEUDO_NR_BASE = 1_000_000
+
+
+def foreach_type(call: Syscall, fn) -> None:
+    """Visit every type reachable from a call signature (incl. ret).
+
+    Mirrors reference sys.ForeachType (sys/decl.go:487): recurses through
+    ptr/array/struct/union; visits each node once per occurrence.
+    """
+    seen: set[int] = set()
+
+    def rec(t: Type):
+        fn(t)
+        if isinstance(t, PtrType) and t.elem is not None:
+            rec(t.elem)
+        elif isinstance(t, ArrayType):
+            rec(t.elem)
+        elif isinstance(t, StructType):
+            if id(t) in seen:
+                return
+            seen.add(id(t))
+            for f in t.fields:
+                rec(f)
+        elif isinstance(t, UnionType):
+            if id(t) in seen:
+                return
+            seen.add(id(t))
+            for o in t.options:
+                rec(o)
+
+    for a in call.args:
+        rec(a)
+    if call.ret is not None:
+        rec(call.ret)

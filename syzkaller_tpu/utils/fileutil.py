@@ -1,0 +1,48 @@
+"""Filesystem helpers: atomic writes, temp dirs, recursive copy.
+
+Capability parity with the reference fileutil package.
+"""
+
+from __future__ import annotations
+
+import os
+import shutil
+import subprocess
+import tempfile
+
+
+def write_file(path: str, data: bytes) -> None:
+    """Atomically write data to path (write temp + rename)."""
+    d = os.path.dirname(path) or "."
+    os.makedirs(d, exist_ok=True)
+    fd, tmp = tempfile.mkstemp(dir=d, prefix=".tmp-")
+    try:
+        with os.fdopen(fd, "wb") as f:
+            f.write(data)
+        os.replace(tmp, path)
+    except BaseException:
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        raise
+
+
+def copy_tree(src: str, dst: str) -> None:
+    shutil.copytree(src, dst, dirs_exist_ok=True)
+
+
+def process_temp_dir(prefix: str = "syz-tpu-") -> str:
+    """Create a temp dir owned by this process; caller removes it."""
+    return tempfile.mkdtemp(prefix=prefix)
+
+
+def umount_all(path: str) -> None:
+    """Best-effort recursive unmount under path (sandbox teardown helper).
+
+    Directory names come from the fuzzed workload, so no shell is involved.
+    """
+    for root, dirs, _files in os.walk(path, topdown=False):
+        for d in dirs:
+            subprocess.run(["umount", "-f", os.path.join(root, d)],
+                           capture_output=True, check=False)

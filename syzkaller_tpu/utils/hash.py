@@ -1,0 +1,20 @@
+"""Content-addressed signatures for corpus programs.
+
+Capability parity with the reference hash package (hash/hash.go:12-35):
+short stable hex signatures used as corpus file names and dedup keys.
+SHA1 is what the reference uses; we keep it for the same non-cryptographic
+content-addressing purpose.
+"""
+
+from __future__ import annotations
+
+import hashlib
+
+
+def sig(data: bytes) -> str:
+    """Hex signature of a serialized program (corpus file name / dedup key)."""
+    return hashlib.sha1(data).hexdigest()
+
+
+def sig_bytes(data: bytes) -> bytes:
+    return hashlib.sha1(data).digest()

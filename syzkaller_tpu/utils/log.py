@@ -1,0 +1,49 @@
+"""Leveled logging with an in-memory ring cache.
+
+Equivalent capability to the reference's log package (log/log.go:4-44):
+global verbosity, Logf-style calls, and an optional bounded in-memory
+cache of recent lines that the manager HTTP UI can serve.
+"""
+
+from __future__ import annotations
+
+import collections
+import sys
+import threading
+import time
+
+_lock = threading.Lock()
+_verbosity = 0
+_cache: collections.deque[str] | None = None
+
+
+def set_verbosity(v: int) -> None:
+    global _verbosity
+    _verbosity = v
+
+
+def enable_log_caching(max_lines: int = 1000) -> None:
+    global _cache
+    with _lock:
+        _cache = collections.deque(maxlen=max_lines)
+
+
+def cached_log() -> str:
+    with _lock:
+        return "\n".join(_cache) if _cache else ""
+
+
+def logf(level: int, fmt: str, *args) -> None:
+    if level > _verbosity:
+        return
+    msg = (fmt % args) if args else fmt
+    line = f"{time.strftime('%Y/%m/%d %H:%M:%S')} {msg}"
+    with _lock:
+        if _cache is not None:
+            _cache.append(line)
+    print(line, file=sys.stderr, flush=True)
+
+
+def fatalf(fmt: str, *args) -> None:
+    logf(0, "FATAL: " + fmt, *args)
+    raise SystemExit(1)

@@ -1,0 +1,26 @@
+"""Test configuration: force an 8-device virtual CPU mesh.
+
+Multi-chip logic is tested on CPU via XLA's host-platform device-count
+flag (SURVEY.md section 4 implication (d)): no mock cluster, the real
+sharded code runs on 8 virtual devices.  Must be set before jax import.
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import numpy as np
+import pytest
+
+
+@pytest.fixture
+def rng():
+    """Seeded numpy Generator; seed is logged for replay on failure."""
+    seed = int(os.environ.get("SYZ_TEST_SEED", "0")) or np.random.SeedSequence().entropy % (2**31)
+    print(f"prng seed: {seed} (set SYZ_TEST_SEED to replay)")
+    return np.random.default_rng(seed)

@@ -1,0 +1,217 @@
+"""Type system + DSL compiler tests (strategy mirrors reference sys tests)."""
+
+import pytest
+
+from syzkaller_tpu.sys import types as T
+from syzkaller_tpu.sys import parser
+from syzkaller_tpu.sys.compiler import Compiler, parse_const_file
+from syzkaller_tpu.sys.table import load_table
+
+
+@pytest.fixture(scope="module")
+def table():
+    return load_table()
+
+
+@pytest.fixture(scope="module")
+def fixture_table():
+    return load_table(files=["probe.txt"])
+
+
+def compile_snippet(text, consts=None):
+    desc = parser.parse(text, "<test>")
+    comp = Compiler(desc, consts or {})
+    return comp.compile()
+
+
+def test_parse_syscall_forms():
+    d = parser.parse(
+        "foo$bar(a0 intptr, a1 ptr[in, array[int8, 5]]) myres\n"
+        "resource myres[int32]: 0, 1\n"
+    )
+    assert d.syscalls[0].name == "foo$bar"
+    assert d.syscalls[0].ret == "myres"
+    assert len(d.syscalls[0].args) == 2
+    assert d.resources["myres"].values == [0, 1]
+
+
+def test_parse_flags_and_strings():
+    d = parser.parse('f1 = 1, 2, X\nnames = "a", "bb"\n')
+    assert d.flags["f1"].values == [1, 2, "X"]
+    assert d.strflags["names"].values == ["a", "bb"]
+
+
+def test_parse_struct_union_attrs():
+    d = parser.parse(
+        "s0 {\n\tf0\tint8\n\tf1\tint32\n} [packed]\n"
+        "u0 [\n\ta\tint8\n\tb\tint64\n] [varlen]\n"
+    )
+    assert d.structs["s0"].attrs == ["packed"]
+    assert d.structs["u0"].is_union and "varlen" in d.structs["u0"].attrs
+
+
+def test_parse_error_reports_location():
+    with pytest.raises(parser.ParseError, match="<t>:2"):
+        parser.parse("foo()\n%%%bad\n", "<t>")
+
+
+def test_const_file_roundtrip():
+    consts = parse_const_file("# c\nA = 10\nB = 0x1f\n")
+    assert consts == {"A": 10, "B": 31}
+
+
+def test_natural_alignment_inserts_padding(fixture_table):
+    st = fixture_table.structs["probe_padded"]
+    names = [(f.field_name(), f.size()) for f in st.fields]
+    # char, pad3, int32, char, pad1, int16, pad4, int64 -> 24 bytes total.
+    assert names == [("c0", 1), ("pad", 3), ("w0", 4), ("c1", 1),
+                     ("pad", 1), ("h0", 2), ("pad", 4), ("q0", 8)]
+    assert st.size() == 24 and st.align() == 8
+
+
+def test_packed_struct_has_no_padding(fixture_table):
+    st = fixture_table.structs["probe_packed"]
+    assert st.size() == 1 + 4 + 1 + 2 + 8 and st.align() == 1
+
+
+def test_align_attribute(fixture_table):
+    st = fixture_table.structs["probe_aligned"]
+    assert st.align() == 8
+
+
+def test_union_size_is_max_option(fixture_table):
+    u = fixture_table.structs["probe_union"]
+    assert isinstance(u, T.UnionType)
+    assert u.size() == 16  # array[int32, 4]
+    assert not u.is_varlen()
+    v = fixture_table.structs["probe_vunion"]
+    assert v.is_varlen()
+
+
+def test_resource_hierarchy_compat(fixture_table):
+    t = fixture_table
+    assert t.is_compatible_resource("probe_res", "probe_res_leaf")
+    assert t.is_compatible_resource("probe_res_leaf", "probe_res")
+    res = t.resources["probe_res_leaf"]
+    assert res.kind == ("probe_res", "probe_res_derived", "probe_res_leaf")
+    # leaf may be passed where base is expected, even in precise mode...
+    assert res.compatible_with(t.resources["probe_res"], precise=True)
+    # ...but base does not satisfy a precise demand for leaf.
+    assert not t.resources["probe_res"].compatible_with(res, precise=True)
+
+
+def test_resource_ctors(fixture_table):
+    ctors = {c.name for c in fixture_table.resource_constructors("probe_res_derived")}
+    assert "syz_probe$res_derive" in ctors
+    # Out-struct fields count as constructors too (dir != IN).
+    assert "syz_probe$res_out" in ctors
+    # res_new produces the base resource which is compatible (imprecise).
+    assert "syz_probe$res_new" in ctors
+
+
+def test_transitive_closure_drops_orphans():
+    c = compile_snippet(
+        "resource r0[int32]\n"
+        "syz_probe$make() r0\n"
+        "syz_probe$use(a r0)\n"
+        "resource r1[int32]\n"
+        "syz_probe$orphan(a r1)\n"
+    )
+    from syzkaller_tpu.sys.table import SyscallTable
+    t = SyscallTable(c.syscalls, c.resources, c.structs)
+    enabled = t.transitively_enabled_calls()
+    names = {x.name for x in enabled}
+    assert names == {"syz_probe$make", "syz_probe$use"}
+    # Disabling the constructor kills the consumer too.
+    sub = t.transitively_enabled_calls(
+        {x for x in t.calls if x.name != "syz_probe$make"})
+    assert {x.name for x in sub} == set()
+
+
+def test_missing_nr_skips_call():
+    c = compile_snippet("unknown_call_zz(a intptr)\n")
+    assert c.syscalls == [] and c.skipped == ["unknown_call_zz"]
+
+
+def test_missing_const_skips_call():
+    c = compile_snippet("syz_probe$x(a const[MISSING_CONST])\n")
+    assert [s for s in c.skipped if "MISSING_CONST" in s]
+
+
+def test_pseudo_numbering():
+    c = compile_snippet("syz_a()\nsyz_b()\nsyz_a$v()\n")
+    nrs = {s.name: s.nr for s in c.syscalls}
+    assert nrs["syz_a"] == nrs["syz_a$v"] == T.PSEUDO_NR_BASE + 1
+    assert nrs["syz_b"] == T.PSEUDO_NR_BASE + 2
+
+
+def test_buffer_kinds():
+    c = compile_snippet(
+        'syz_probe$b(a ptr[in, string["abc"]], b ptr[in, array[int8]], '
+        'c ptr[in, array[int8, 4:8]], d buffer[out], e ptr[in, string["x", 10]])\n')
+    call = c.syscalls[0]
+    s = call.args[0].elem
+    assert s.kind == T.BufferKind.STRING and s.size() == 4  # "abc" + NUL
+    blob = call.args[1].elem
+    assert blob.kind == T.BufferKind.BLOB_RAND and blob.is_varlen()
+    rng = call.args[2].elem
+    assert rng.kind == T.BufferKind.BLOB_RANGE and (rng.range_begin, rng.range_end) == (4, 8)
+    out = call.args[3]
+    assert isinstance(out, T.PtrType) and out.dir == T.Dir.OUT
+    padded = call.args[4].elem
+    assert padded.size() == 10
+
+
+def test_endian_types(fixture_table):
+    st = fixture_table.structs["probe_endian"]
+    by_name = {f.field_name(): f for f in st.fields}
+    assert by_name["h"].big_endian and by_name["h"].type_size == 2
+    assert by_name["total"].big_endian and isinstance(by_name["total"], T.LenType)
+    assert by_name["magic"].val == 0x1234
+
+
+def test_proc_type(fixture_table):
+    call = fixture_table["syz_probe$proc"]
+    port = call.args[0]
+    assert isinstance(port, T.ProcType)
+    assert (port.values_start, port.values_per_proc) == (20000, 4)
+    assert port.big_endian and port.type_size == 2
+
+
+def test_vma_ranges(fixture_table):
+    call = fixture_table["syz_probe$vma"]
+    v0, _, v1, _, v2, _ = call.args
+    assert (v0.range_begin, v0.range_end) == (0, 0)
+    assert (v1.range_begin, v1.range_end) == (4, 4)
+    assert (v2.range_begin, v2.range_end) == (2, 6)
+
+
+def test_full_linux_table_loads(table):
+    assert table.count > 200
+    assert not table.skipped, table.skipped
+    assert "open" in table.call_map and "mmap" in table.call_map
+    # open returns an fd resource creatable => closure keeps read/write.
+    enabled = table.transitively_enabled_calls()
+    names = {c.name for c in enabled}
+    assert {"open", "read", "write", "close"} <= names
+
+
+def test_recursive_struct_via_ptr():
+    c = compile_snippet(
+        "node {\n\tval\tint64\n\tnext\tptr[in, node, opt]\n}\n"
+        "syz_probe$rec(p ptr[in, node])\n")
+    node = c.structs["node"]
+    # next's pointee is the same struct instance (cycle), size stays finite.
+    nxt = node.fields[1]
+    assert isinstance(nxt, T.PtrType) and nxt.elem is node
+    assert node.size() == 16
+
+
+def test_dir_propagation():
+    c = compile_snippet(
+        "pair {\n\ta\tint32\n\tb\tint32\n}\n"
+        "syz_probe$d(i ptr[in, pair], o ptr[out, pair])\n")
+    call = c.syscalls[0]
+    assert call.args[0].elem.dir == T.Dir.IN
+    assert call.args[1].elem.dir == T.Dir.OUT
+    assert all(f.dir == T.Dir.OUT for f in call.args[1].elem.fields)
