@@ -1,0 +1,25 @@
+"""The program layer: model, generation, mutation, serialization.
+
+Host-side structured core (SURVEY §7): program trees are branchy CPU
+work; all *sampling decisions* flow through prog.rand.Rand, which can be
+batch-refilled from device-generated randomness.
+"""
+
+from syzkaller_tpu.prog.model import (  # noqa: F401
+    Arg, Call, ConstArg, DataArg, GroupArg, PageSizeArg, PointerArg, Prog,
+    ResultArg, ReturnArg, UnionArg, clone_prog, default_arg, default_call,
+    foreach_arg, foreach_subarg, insert_before, remove_call, replace_arg,
+)
+from syzkaller_tpu.prog.analysis import (  # noqa: F401
+    State, analyze, assign_sizes_call, sanitize_call,
+)
+from syzkaller_tpu.prog.encoding import (  # noqa: F401
+    DeserializeError, call_set, deserialize, serialize,
+)
+from syzkaller_tpu.prog.encodingexec import serialize_for_exec  # noqa: F401
+from syzkaller_tpu.prog.generation import generate  # noqa: F401
+from syzkaller_tpu.prog.mutation import minimize, mutate, trim_after  # noqa: F401
+from syzkaller_tpu.prog.parse import parse_log  # noqa: F401
+from syzkaller_tpu.prog.prio import ChoiceTable, calculate_priorities  # noqa: F401
+from syzkaller_tpu.prog.rand import Gen, Rand  # noqa: F401
+from syzkaller_tpu.prog.validation import ValidationError, validate  # noqa: F401

@@ -1,0 +1,210 @@
+"""Program state analysis: prefix replay, len-field solving, call sanitizing.
+
+Capability parity with reference prog/analysis.go: the `state` struct
+(pages/resources/files/strings, :21-27), `analyze` prefix replay (:30-39),
+mmap/munmap page accounting (:70-113), the `assignSizes` length-field
+solver (:173-214), and `sanitizeCall` safety rewrites (:216-282).
+"""
+
+from __future__ import annotations
+
+from syzkaller_tpu.prog import model as M
+from syzkaller_tpu.sys import types as T
+from syzkaller_tpu.sys.table import SyscallTable
+
+
+class State:
+    """Accumulated state of a program prefix: which resources exist, which
+    files/strings were used, and which pages of the data window are mapped."""
+
+    def __init__(self, table: SyscallTable):
+        self.table = table
+        # resource kind-name -> list of args that produce a value of it
+        self.resources: dict[str, list[M.Arg]] = {}
+        self.files: set[bytes] = set()
+        self.strings: set[bytes] = set()
+        self.pages = [False] * M.MAX_PAGES
+
+    # -- page accounting ----------------------------------------------------
+
+    def mark_pages(self, page: int, npages: int, mapped: bool) -> None:
+        for i in range(page, min(page + npages, M.MAX_PAGES)):
+            self.pages[i] = mapped
+
+    def alloc_pages(self, npages: int) -> "int | None":
+        """First-fit span of npages mapped pages; None if no mapped span."""
+        run = 0
+        for i, m in enumerate(self.pages):
+            run = run + 1 if m else 0
+            if run >= npages:
+                return i - npages + 1
+        return None
+
+    # -- call replay ----------------------------------------------------
+
+    def analyze_call(self, c: M.Call) -> None:
+        def note(a: M.Arg, _p):
+            t = a.typ
+            if isinstance(t, T.ResourceType) and t.dir != T.Dir.IN:
+                self.resources.setdefault(t.desc.name, []).append(a)
+            if isinstance(a, M.DataArg) and isinstance(t, T.BufferType):
+                if t.kind == T.BufferKind.FILENAME:
+                    self.files.add(a.data)
+                elif t.kind == T.BufferKind.STRING:
+                    self.strings.add(a.data)
+
+        M.foreach_arg(c, note)
+        if c.ret is not None and isinstance(c.meta.ret, T.ResourceType):
+            self.resources.setdefault(c.meta.ret.desc.name, []).append(c.ret)
+
+        name = c.meta.call_name
+        if name == "mmap" and len(c.args) >= 2:
+            self._pages_op(c.args[0], c.args[1], True)
+        elif name == "munmap" and len(c.args) >= 2:
+            self._pages_op(c.args[0], c.args[1], False)
+        elif name == "mremap" and len(c.args) >= 5:
+            self._pages_op(c.args[4], c.args[2], True)
+
+    def _pages_op(self, addr: M.Arg, length: M.Arg, mapped: bool) -> None:
+        if not isinstance(addr, M.PointerArg):
+            return
+        n = 0
+        if isinstance(length, M.PageSizeArg):
+            n = length.npages
+        elif isinstance(length, M.ConstArg):
+            n = (length.val + M.PAGE_SIZE - 1) // M.PAGE_SIZE
+        if n > 0:
+            self.mark_pages(addr.page, n, mapped)
+
+
+def analyze(table: SyscallTable, p: M.Prog, upto: "M.Call | None" = None) -> State:
+    """Replay the prefix of p before `upto` (all calls if None) into a State
+    (ref prog/analysis.go:30-39)."""
+    s = State(table)
+    for c in p.calls:
+        if c is upto:
+            break
+        s.analyze_call(c)
+    return s
+
+
+# ---------------------------------------------------------------------------
+# Length-field solving (ref prog/analysis.go:173-214).
+
+
+def _node_size(a: M.Arg) -> int:
+    return a.size()
+
+
+def _elem_count(a: M.Arg) -> int:
+    if isinstance(a, M.GroupArg):
+        return len(a.inner)
+    if isinstance(a, M.DataArg):
+        return len(a.data)
+    if isinstance(a, M.PointerArg) and a.npages:
+        return a.npages * M.PAGE_SIZE
+    return 1
+
+
+def _len_value(lt: T.LenType, target: M.Arg) -> int:
+    t = target.typ
+    if isinstance(t, T.VmaType):
+        npages = target.npages if isinstance(target, M.PointerArg) else 0
+        return npages * M.PAGE_SIZE // (lt.byte_size or 1)
+    if isinstance(target, M.PointerArg):
+        # len of a pointer measures the pointee.
+        if target.res is None:
+            return 0
+        target, t = target.res, target.res.typ
+    if lt.byte_size:
+        return _node_size(target) // lt.byte_size
+    # len[] counts elements of arrays/buffers, bytes otherwise.
+    if isinstance(t, T.ArrayType) or isinstance(target, M.DataArg):
+        return _elem_count(target)
+    return _node_size(target)
+
+
+def _assign_sizes(args: list[M.Arg], parent_fields: "list[M.Arg] | None" = None) -> None:
+    """Resolve every LenType among `args` against its sibling by field name
+    ('parent' refers to the struct enclosing the len field)."""
+    by_name: dict[str, M.Arg] = {}
+    for a in args:
+        fname = a.typ.field_name()
+        if fname:
+            by_name.setdefault(fname, a)
+
+    def len_node(a: M.Arg) -> "M.ConstArg | None":
+        # A len can sit directly among the siblings, or one pointer deref
+        # down (`n ptr[inout, len[p, int64]]` — ref assignSizesCall).
+        if isinstance(a, M.ConstArg) and isinstance(a.typ, T.LenType):
+            return a
+        if (isinstance(a, M.PointerArg) and a.res is not None
+                and isinstance(a.res, M.ConstArg)
+                and isinstance(a.res.typ, T.LenType)):
+            return a.res
+        return None
+
+    for a in args:
+        ln = len_node(a)
+        if ln is None:
+            continue
+        lt = ln.typ
+        assert isinstance(lt, T.LenType)
+        if lt.buf == "parent":
+            continue  # handled by the caller with the parent group
+        tgt = by_name.get(lt.buf)
+        if tgt is None:
+            continue  # dangling len: description bug, keep current value
+        ln.val = _len_value(lt, tgt)
+
+
+def assign_sizes_call(c: M.Call) -> None:
+    """Solve len fields at the top level of the call and inside every
+    struct (a len field refers to its siblings)."""
+    _assign_sizes(c.args)
+
+    def rec(a: M.Arg, _p):
+        if isinstance(a, M.GroupArg) and isinstance(a.typ, T.StructType):
+            _assign_sizes(a.inner)
+            # len[parent] = byte size of the enclosing struct.
+            for f in a.inner:
+                if (isinstance(f, M.ConstArg) and isinstance(f.typ, T.LenType)
+                        and f.typ.buf == "parent"):
+                    f.val = a.size() // (f.typ.byte_size or 1)
+
+    M.foreach_arg(c, rec)
+
+
+# ---------------------------------------------------------------------------
+# Call sanitizing (ref prog/analysis.go:216-282): rewrite generated values
+# that would break the fuzzer itself rather than test the kernel.
+
+MAP_FIXED = 0x10
+
+
+def sanitize_call(c: M.Call) -> None:
+    name = c.meta.call_name
+    if name == "mmap" and len(c.args) >= 4:
+        # Always MAP_FIXED so the page-accounting model matches reality.
+        flags = c.args[3]
+        if isinstance(flags, M.ConstArg):
+            flags.val |= MAP_FIXED
+    elif name == "mknod" and len(c.args) >= 2:
+        mode = c.args[1]
+        if isinstance(mode, M.ConstArg) and mode.val % 8 not in (0, 1, 2, 4, 6):
+            mode.val = 0o10000 | 0o666  # S_IFIFO
+    elif name == "exit" or name == "exit_group":
+        # Reserved magic statuses signal executor control flow, not a test
+        # outcome (ref executor taxonomy; common.h:46-48).
+        if c.args and isinstance(c.args[0], M.ConstArg):
+            if c.args[0].val % 128 in (67, 68, 69):
+                c.args[0].val = 1
+    elif name == "ptrace" and c.args:
+        # PTRACE_TRACEME freezes the executor under its own supervision.
+        req = c.args[0]
+        if isinstance(req, M.ConstArg) and req.val == 0:
+            req.val = 0xFFFFFFFF
+    elif name == "ioctl" and len(c.args) >= 2:
+        req = c.args[1]
+        if isinstance(req, M.ConstArg) and req.val == 0xC0045877:  # FIFREEZE
+            req.val = 0xC0045878  # FITHAW

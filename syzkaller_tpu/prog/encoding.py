@@ -1,0 +1,331 @@
+"""Human-readable program serialization (the corpus interchange format).
+
+Capability parity with reference prog/encoding.go:29-120 (Serialize /
+Deserialize roundtrip, CallSet).  The surface syntax follows the
+reference's style:
+
+    r0 = open(&(0x20001000)="2e2f66696c653000", 0x2, 0x0)
+    read(r0, &(0x20002000)="00", 0x1)
+    mmap(&(0x20000000/0x3000)=nil, (0x3000), 0x3, 0x32, 0xffffffffffffffff, 0x0)
+
+    const            0x1f
+    result ref       r0, r0/0x3+0x1   (value = r0 / 0x3 + 0x1)
+    pointer          &(0xaddr)=pointee ;  null pointer: nil
+    vma              &(0xaddr/0xlen)=nil
+    page-size len    (0xlen)
+    data             "hex bytes"
+    struct           {a, b}
+    array            [a, b]
+    union            @option_field=arg
+    out-resource     <r1=>0x0         (names an inner arg for later refs)
+
+Deserialization is type-directed: the call signature drives which arg
+node each token becomes, so a program only parses against the table it
+was written with (corpus verify-on-load discards stale programs, like
+the reference syz-manager/persistent.go:22-102).
+"""
+
+from __future__ import annotations
+
+from syzkaller_tpu.prog import analysis
+from syzkaller_tpu.prog import model as M
+from syzkaller_tpu.sys import types as T
+from syzkaller_tpu.sys.table import SyscallTable
+
+
+class DeserializeError(Exception):
+    pass
+
+
+# ---------------------------------------------------------------------------
+# Serialize
+
+
+def serialize(p: M.Prog) -> bytes:
+    ids: dict[int, int] = {}   # id(arg) -> rN
+    next_id = [0]
+
+    def name_of(a: M.Arg) -> int:
+        key = id(a)
+        if key not in ids:
+            ids[key] = next_id[0]
+            next_id[0] += 1
+        return ids[key]
+
+    # Pre-assign indices in program order so refs are always backward.
+    for c in p.calls:
+        for a in M.all_args(c):
+            if a.uses:
+                name_of(a)
+        if c.ret is not None and c.ret.uses:
+            name_of(c.ret)
+
+    lines = []
+    for c in p.calls:
+        s = ""
+        if c.ret is not None and id(c.ret) in ids:
+            s += f"r{ids[id(c.ret)]} = "
+        s += c.meta.name + "(" + ", ".join(_ser_arg(a, ids) for a in c.args) + ")"
+        lines.append(s)
+    return ("\n".join(lines) + "\n").encode()
+
+
+def _ser_arg(a: M.Arg, ids: dict[int, int]) -> str:
+    prefix = f"<r{ids[id(a)]}=>" if id(a) in ids and not isinstance(a, M.ReturnArg) else ""
+    if isinstance(a, M.ConstArg):
+        return prefix + hex(a.val)
+    if isinstance(a, M.ResultArg):
+        if a.res is None:
+            return prefix + hex(a.val)
+        s = f"r{ids[id(a.res)]}"
+        if a.op_div:
+            s += f"/{hex(a.op_div)}"
+        if a.op_add:
+            s += f"+{hex(a.op_add)}"
+        return prefix + s
+    if isinstance(a, M.PointerArg):
+        va = M.DATA_OFFSET + a.address()
+        if a.npages:
+            return prefix + f"&({hex(va)}/{hex(a.npages * M.PAGE_SIZE)})=nil"
+        if a.res is None:
+            return prefix + "nil"
+        return prefix + f"&({hex(va)})=" + _ser_arg(a.res, ids)
+    if isinstance(a, M.PageSizeArg):
+        return prefix + f"({hex(a.npages * M.PAGE_SIZE)})"
+    if isinstance(a, M.DataArg):
+        return prefix + '"' + a.data.hex() + '"'
+    if isinstance(a, M.GroupArg):
+        op, cl = ("[", "]") if isinstance(a.typ, T.ArrayType) else ("{", "}")
+        return prefix + op + ", ".join(_ser_arg(x, ids) for x in a.inner) + cl
+    if isinstance(a, M.UnionArg):
+        return prefix + "@" + a.option_typ.field_name() + "=" + _ser_arg(a.option, ids)
+    if isinstance(a, M.ReturnArg):
+        return prefix + "0x0"
+    raise TypeError(f"serialize: unknown arg {type(a)}")
+
+
+def call_set(data: bytes) -> set[str]:
+    """Set of call names in a serialized program without a full parse
+    (ref prog/encoding.go CallSet)."""
+    out = set()
+    for line in data.decode(errors="replace").splitlines():
+        line = line.strip()
+        if not line or line.startswith("#"):
+            continue
+        if "=" in line.split("(", 1)[0]:
+            line = line.split("=", 1)[1].strip()
+        name = line.split("(", 1)[0].strip()
+        if name:
+            out.add(name)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Deserialize
+
+
+class _P:
+    def __init__(self, s: str, line_no: int):
+        self.s = s
+        self.i = 0
+        self.line_no = line_no
+
+    def err(self, msg: str):
+        raise DeserializeError(f"line {self.line_no}: {msg} (at {self.s[self.i:self.i+25]!r})")
+
+    def ws(self):
+        while self.i < len(self.s) and self.s[self.i] in " \t":
+            self.i += 1
+
+    def peek(self) -> str:
+        self.ws()
+        return self.s[self.i] if self.i < len(self.s) else ""
+
+    def eat(self, ch: str):
+        if self.peek() != ch:
+            self.err(f"expected {ch!r}")
+        self.i += 1
+
+    def ident(self) -> str:
+        self.ws()
+        st = self.i
+        while self.i < len(self.s) and (self.s[self.i].isalnum() or self.s[self.i] in "_$"):
+            self.i += 1
+        if st == self.i:
+            self.err("expected identifier")
+        return self.s[st:self.i]
+
+    def num(self) -> int:
+        self.ws()
+        st = self.i
+        if self.s[self.i:self.i + 2].lower() == "0x":
+            self.i += 2
+            while self.i < len(self.s) and self.s[self.i] in "0123456789abcdefABCDEF":
+                self.i += 1
+            if self.i == st + 2:
+                self.err("bare 0x with no hex digits")
+            return int(self.s[st + 2:self.i], 16)
+        while self.i < len(self.s) and self.s[self.i].isdigit():
+            self.i += 1
+        if st == self.i:
+            self.err("expected number")
+        return int(self.s[st:self.i])
+
+
+def deserialize(data: bytes, table: SyscallTable) -> M.Prog:
+    p = M.Prog()
+    refs: dict[int, M.Arg] = {}
+    for line_no, raw in enumerate(data.decode().splitlines(), 1):
+        line = raw.strip()
+        if not line or line.startswith("#"):
+            continue
+        pr = _P(line, line_no)
+        ret_ref: "int | None" = None
+        # Optional "rN = " prefix.
+        save = pr.i
+        if pr.peek() == "r":
+            tok = pr.ident()
+            if tok[1:].isdigit() and pr.peek() == "=":
+                pr.eat("=")
+                ret_ref = int(tok[1:])
+            else:
+                pr.i = save
+        name = pr.ident()
+        meta = table.call_map.get(name)
+        if meta is None:
+            raise DeserializeError(f"line {line_no}: unknown call {name}")
+        pr.eat("(")
+        args: list[M.Arg] = []
+        for i, at in enumerate(meta.args):
+            if i > 0:
+                pr.eat(",")
+            args.append(_parse_arg(pr, at, refs))
+        pr.eat(")")
+        c = M.Call(meta, args)
+        if meta.ret is not None:
+            c.ret = M.ReturnArg(meta.ret)
+            if ret_ref is not None:
+                refs[ret_ref] = c.ret
+        elif ret_ref is not None:
+            raise DeserializeError(f"line {line_no}: {name} has no return resource")
+        analysis.assign_sizes_call(c)
+        p.calls.append(c)
+    return p
+
+
+def _parse_arg(pr: _P, t: T.Type, refs: dict[int, M.Arg]) -> M.Arg:
+    ref_id: "int | None" = None
+    if pr.peek() == "<":
+        pr.eat("<")
+        tok = pr.ident()
+        if not tok.startswith("r") or not tok[1:].isdigit():
+            pr.err("expected <rN=>")
+        ref_id = int(tok[1:])
+        pr.eat("=")
+        pr.eat(">")
+    a = _parse_arg_inner(pr, t, refs)
+    if ref_id is not None:
+        refs[ref_id] = a
+    return a
+
+
+def _parse_arg_inner(pr: _P, t: T.Type, refs: dict[int, M.Arg]) -> M.Arg:
+    ch = pr.peek()
+    if ch == "n":  # nil
+        if pr.ident() != "nil":
+            pr.err("expected nil")
+        if isinstance(t, (T.PtrType, T.VmaType)):
+            return M.PointerArg(t, 0, 0, 0, None)
+        pr.err(f"nil for non-pointer {t.name}")
+    if ch == "&":
+        pr.eat("&")
+        pr.eat("(")
+        addr = pr.num()
+        if addr >= M.DATA_OFFSET:
+            addr -= M.DATA_OFFSET
+        page, off = divmod(addr, M.PAGE_SIZE)
+        if pr.peek() == "/":
+            pr.eat("/")
+            ln = pr.num()
+            pr.eat(")")
+            pr.eat("=")
+            if pr.ident() != "nil":
+                pr.err("vma pointee must be nil")
+            return M.PointerArg(t, page, off, ln // M.PAGE_SIZE, None)
+        pr.eat(")")
+        pr.eat("=")
+        if not isinstance(t, T.PtrType):
+            pr.err(f"pointer value for {t.name}")
+        elem_t = t.elem if t.elem is not None else T.BufferType(
+            name="blob", dir=t.dir, kind=T.BufferKind.BLOB_RAND)
+        elem = _parse_arg(pr, elem_t, refs)
+        return M.PointerArg(t, page, off, 0, elem)
+    if ch == "(":
+        pr.eat("(")
+        v = pr.num()
+        pr.eat(")")
+        return M.PageSizeArg(t, v // M.PAGE_SIZE)
+    if ch == '"':
+        pr.eat('"')
+        st = pr.i
+        while pr.i < len(pr.s) and pr.s[pr.i] != '"':
+            pr.i += 1
+        hexs = pr.s[st:pr.i]
+        pr.eat('"')
+        try:
+            data = bytes.fromhex(hexs)
+        except ValueError:
+            pr.err("bad hex data")
+        return M.DataArg(t, data)
+    if ch in "{[":
+        close = "}" if ch == "{" else "]"
+        pr.eat(ch)
+        inner: list[M.Arg] = []
+        if isinstance(t, T.StructType):
+            for i, f in enumerate(t.fields):
+                if i > 0:
+                    pr.eat(",")
+                inner.append(_parse_arg(pr, f, refs))
+        elif isinstance(t, T.ArrayType):
+            while pr.peek() != close:
+                if inner:
+                    pr.eat(",")
+                inner.append(_parse_arg(pr, t.elem, refs))
+        else:
+            pr.err(f"group value for scalar {t.name}")
+        pr.eat(close)
+        return M.GroupArg(t, inner)
+    if ch == "@":
+        pr.eat("@")
+        fname = pr.ident()
+        pr.eat("=")
+        if not isinstance(t, T.UnionType):
+            pr.err(f"union value for {t.name}")
+        for opt in t.options:
+            if opt.field_name() == fname:
+                a = _parse_arg(pr, opt, refs)
+                return M.UnionArg(t, a, opt)
+        pr.err(f"unknown union option {fname}")
+    if ch == "r":
+        save = pr.i
+        tok = pr.ident()
+        if tok[1:].isdigit():
+            n = int(tok[1:])
+            target = refs.get(n)
+            if target is None:
+                pr.err(f"undefined result r{n}")
+            op_div = op_add = 0
+            if pr.peek() == "/":
+                pr.eat("/")
+                op_div = pr.num()
+            if pr.peek() == "+":
+                pr.eat("+")
+                op_add = pr.num()
+            return M.ResultArg(t, target, 0, op_div, op_add)
+        pr.i = save
+        pr.err("bad token")
+    # Plain number: const scalar, or a literal-valued resource.
+    v = pr.num()
+    if isinstance(t, T.ResourceType):
+        return M.ResultArg(t, None, v)
+    return M.ConstArg(t, v)

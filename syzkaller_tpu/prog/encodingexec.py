@@ -1,0 +1,155 @@
+"""Flat exec bytecode: the wire format between fuzzer and executor.
+
+Capability parity with reference prog/encodingexec.go:15-129 (the
+copyin/call/copyout uint64 instruction stream + physical addressing).
+The format here is this framework's own — the native executor
+(native/executor.cc) implements the identical decoder, and
+tests/test_exec roundtrips golden byte sequences against it.
+
+All words are uint64 little-endian:
+
+    instr  := COPYIN addr arg
+            | COPYOUT result_idx addr size
+            | CALL nr result_idx nargs arg*
+            | EOF
+    arg    := ARG_CONST size value          (value pre-encoded: BE types
+                                             are byte-swapped here)
+            | ARG_RESULT size result_idx op_div op_add
+            | ARG_DATA size data_word*      (ceil(size/8) words)
+
+    EOF = 2^64-1, COPYIN = 2^64-2, COPYOUT = 2^64-3; any smaller first
+    word starts a CALL.  result_idx of NO_RESULT (2^64-1) means the
+    call's return value is unused.  Addresses are physical: DATA_OFFSET +
+    page*PAGE_SIZE + offset (ref physicalAddr encodingexec.go:118-129).
+"""
+
+from __future__ import annotations
+
+import struct
+
+from syzkaller_tpu.prog import model as M
+from syzkaller_tpu.sys import types as T
+
+INSTR_EOF = (1 << 64) - 1
+INSTR_COPYIN = (1 << 64) - 2
+INSTR_COPYOUT = (1 << 64) - 3
+ARG_CONST = 0
+ARG_RESULT = 1
+ARG_DATA = 2
+NO_RESULT = (1 << 64) - 1
+
+
+class ExecEncodeError(Exception):
+    pass
+
+
+def physical_addr(a: M.PointerArg) -> int:
+    return M.DATA_OFFSET + a.address()
+
+
+def _encode_scalar(a: "M.ConstArg | M.ResultArg", pid: int) -> int:
+    """Scalar value as the executor should write it to memory: per-proc
+    biasing applied and big-endian types byte-swapped within their width
+    (ref prog/prog.go:71-103)."""
+    if isinstance(a, M.ConstArg):
+        v = a.value(pid)
+    else:
+        v = a.val
+    t = a.typ
+    size = getattr(t, "type_size", 8)
+    v &= (1 << (8 * size)) - 1
+    if getattr(t, "big_endian", False):
+        v = int.from_bytes(v.to_bytes(size, "little"), "big")
+    return v
+
+
+def serialize_for_exec(p: M.Prog, pid: int = 0) -> bytes:
+    w: list[int] = []
+    result_idx: dict[int, int] = {}
+
+    def idx_of(a: M.Arg) -> int:
+        key = id(a)
+        if key not in result_idx:
+            result_idx[key] = len(result_idx)
+        return result_idx[key]
+
+    def emit_arg(a: M.Arg) -> None:
+        if isinstance(a, M.ConstArg):
+            w.extend([ARG_CONST, a.size(), _encode_scalar(a, pid)])
+        elif isinstance(a, M.ResultArg):
+            if a.res is None:
+                w.extend([ARG_CONST, a.size(), _encode_scalar(a, pid)])
+            else:
+                w.extend([ARG_RESULT, a.size(), idx_of(a.res),
+                          a.op_div, a.op_add])
+        elif isinstance(a, M.PointerArg):
+            w.extend([ARG_CONST, 8, physical_addr(a) if not a.is_null else 0])
+        elif isinstance(a, M.PageSizeArg):
+            w.extend([ARG_CONST, a.size() if not isinstance(a.typ, T.LenType)
+                      else a.typ.size(), a.npages * M.PAGE_SIZE])
+        elif isinstance(a, M.DataArg):
+            n = len(a.data)
+            w.extend([ARG_DATA, n])
+            pad = a.data + b"\x00" * (-n % 8)
+            for i in range(0, len(pad), 8):
+                w.append(int.from_bytes(pad[i:i + 8], "little"))
+        else:
+            raise ExecEncodeError(f"cannot emit {type(a)} as call arg")
+
+    def emit_copyin(a: M.Arg, addr: int) -> None:
+        """Copy the pointee subtree into the data window, leaf by leaf."""
+        if isinstance(a, M.GroupArg):
+            off = 0
+            for x in a.inner:
+                emit_copyin(x, addr + off)
+                off += x.size()
+            return
+        if isinstance(a, M.UnionArg):
+            emit_copyin(a.option, addr)
+            return
+        if a.typ.dir == T.Dir.OUT and isinstance(a, M.DataArg):
+            return  # kernel writes it; skip the copyin
+        if isinstance(a, M.DataArg) and not a.data:
+            return
+        w.append(INSTR_COPYIN)
+        w.append(addr)
+        emit_arg(a)
+        if isinstance(a, M.PointerArg) and a.res is not None:
+            emit_copyin(a.res, physical_addr(a))
+
+    def emit_copyout(a: M.Arg, addr: int) -> None:
+        """COPYOUT for every used out-resource in the pointee (so later
+        ARG_RESULT refs see kernel-written ids)."""
+        if isinstance(a, M.GroupArg):
+            off = 0
+            for x in a.inner:
+                emit_copyout(x, addr + off)
+                off += x.size()
+            return
+        if isinstance(a, M.UnionArg):
+            emit_copyout(a.option, addr)
+            return
+        if isinstance(a, M.PointerArg) and a.res is not None:
+            emit_copyout(a.res, physical_addr(a))
+            return
+        if isinstance(a, M.ResultArg) and a.uses:
+            w.extend([INSTR_COPYOUT, idx_of(a), addr, a.size()])
+
+    for c in p.calls:
+        for a in c.args:
+            if isinstance(a, M.PointerArg) and a.res is not None:
+                emit_copyin(a.res, physical_addr(a))
+        ridx = idx_of(c.ret) if (c.ret is not None and c.ret.uses) else NO_RESULT
+        w.append(c.meta.nr)
+        w.append(ridx)
+        w.append(len(c.args))
+        for a in c.args:
+            emit_arg(a)
+        for a in c.args:
+            if isinstance(a, M.PointerArg) and a.res is not None:
+                emit_copyout(a.res, physical_addr(a))
+    w.append(INSTR_EOF)
+    try:
+        return struct.pack(f"<{len(w)}Q", *w)
+    except struct.error as e:
+        raise ExecEncodeError(str(e)) from e

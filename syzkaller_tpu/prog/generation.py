@@ -1,0 +1,39 @@
+"""Whole-program generation.
+
+Capability parity with reference prog/generation.go:12-27: grow a
+program call-by-call under a choice table until the target length,
+replaying state so later calls can consume earlier calls' resources.
+"""
+
+from __future__ import annotations
+
+from syzkaller_tpu.prog import model as M
+from syzkaller_tpu.prog.analysis import State
+from syzkaller_tpu.prog.rand import Gen, Rand
+from syzkaller_tpu.sys.table import SyscallTable
+
+
+def generate(rand: Rand, table: SyscallTable, ncalls: int,
+             choice_table=None, pid: int = 0) -> M.Prog:
+    p = M.Prog()
+    state = State(table)
+    gen = Gen(rand, state, table, choice_table, pid)
+    while len(p.calls) < ncalls:
+        prev = p.calls[rand.intn(len(p.calls))].meta.id if p.calls else -1
+        p.calls.extend(gen.generate_call(prev))
+    # Growing by >1 call at a time (resource ctors) can overshoot.
+    if len(p.calls) > ncalls:
+        for i in range(len(p.calls) - 1, -1, -1):
+            if len(p.calls) <= ncalls:
+                break
+            # Only drop calls whose results nothing references.
+            c = p.calls[i]
+            used = (c.ret is not None and c.ret.uses)
+            if not used:
+                for a in list(M.all_args(c)):
+                    if a.uses:
+                        used = True
+                        break
+            if not used:
+                M.remove_call(p, i)
+    return p

@@ -1,0 +1,391 @@
+"""The program model: a Prog is a sequence of Calls over an Arg tree.
+
+Capability parity with the reference program model (prog/prog.go:12-245):
+the same arg taxonomy (const / result / pointer / page-size / data /
+group / union / return — prog/prog.go:41-52), result cross-links with
+use-tracking, value encoding incl. big-endian and per-proc values
+(prog/prog.go:71-103), and tree surgery that keeps the uses-links
+consistent (insertBefore/replaceArg/removeArg/removeCall,
+prog/prog.go:174-245).
+
+Design differences: args are typed subclasses instead of a kind-tagged
+struct; addresses are explicit (page, offset) pairs resolved against
+DATA_OFFSET only at exec-serialization time, keeping the model
+position-independent for the device-side corpus store.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Callable, Iterator, Optional
+
+from syzkaller_tpu.sys import types as T
+
+PAGE_SIZE = T.PAGE_SIZE
+MAX_PAGES = 4 << 10            # 16MB program address space (ref prog/analysis.go:18)
+DATA_OFFSET = 512 << 20        # virtual base of the data window (ref prog/encodingexec.go:27-31)
+
+
+class Arg:
+    """Base of all argument nodes.
+
+    typ   -- the sys.Type this node instantiates.
+    uses  -- set of ResultArg nodes whose value refers to this node.
+    """
+
+    __slots__ = ("typ", "uses")
+
+    def __init__(self, typ: T.Type):
+        self.typ = typ
+        self.uses: set[ResultArg] = set()
+
+    def size(self) -> int:
+        return self.typ.size()
+
+
+class ConstArg(Arg):
+    """Scalar immediate: const/int/flags/len/proc/csum values."""
+
+    __slots__ = ("val",)
+
+    def __init__(self, typ: T.Type, val: int):
+        super().__init__(typ)
+        self.val = val
+
+    def value(self, pid: int = 0) -> int:
+        """The encoded scalar as the kernel should see it (before
+        byte-order encoding).  ProcType values are biased per-process so
+        concurrent fuzzer procs touch disjoint ids (ref prog/prog.go:98-100,
+        sys/decl.go:242-256)."""
+        t = self.typ
+        if isinstance(t, T.ProcType):
+            return t.values_start + t.values_per_proc * pid + self.val
+        return self.val
+
+
+class ResultArg(Arg):
+    """Reference to the result of a previous call (or an out-resource arg).
+
+    res is the referenced arg (its .uses contains self); if None, val is
+    used as a literal fallback.  op_div/op_add post-process the runtime
+    value: v = v / op_div + op_add (div first — ref prog/prog.go:30-33).
+    """
+
+    __slots__ = ("res", "val", "op_div", "op_add")
+
+    def __init__(self, typ: T.Type, res: "Arg | None", val: int,
+                 op_div: int = 0, op_add: int = 0):
+        super().__init__(typ)
+        self.res = res
+        self.val = val
+        self.op_div = op_div
+        self.op_add = op_add
+        if res is not None:
+            res.uses.add(self)
+
+
+class PointerArg(Arg):
+    """Pointer into the data window: page*PAGE_SIZE + offset.
+
+    res is the pointee (None for vma regions and null pointers);
+    npages > 0 marks a vma region of that many pages.
+    """
+
+    __slots__ = ("page", "offset", "npages", "res")
+
+    def __init__(self, typ: T.Type, page: int, offset: int,
+                 npages: int, res: "Arg | None"):
+        super().__init__(typ)
+        self.page = page
+        self.offset = offset
+        self.npages = npages
+        self.res = res
+
+    def address(self) -> int:
+        return self.page * PAGE_SIZE + self.offset
+
+    @property
+    def is_null(self) -> bool:
+        return self.res is None and self.npages == 0 and self.page == 0 and self.offset == 0
+
+
+class PageSizeArg(Arg):
+    """A length expressed in pages (vma sizes, mmap len — ref ArgPageSize
+    prog/prog.go:44-45): value = npages * PAGE_SIZE."""
+
+    __slots__ = ("npages",)
+
+    def __init__(self, typ: T.Type, npages: int):
+        super().__init__(typ)
+        self.npages = npages
+
+
+class DataArg(Arg):
+    """In-memory byte blob (buffers, strings, filenames, text)."""
+
+    __slots__ = ("data",)
+
+    def __init__(self, typ: T.Type, data: bytes):
+        super().__init__(typ)
+        self.data = bytes(data)
+
+    def size(self) -> int:
+        return len(self.data)
+
+
+class GroupArg(Arg):
+    """Struct or array: ordered child args."""
+
+    __slots__ = ("inner",)
+
+    def __init__(self, typ: T.Type, inner: list["Arg"]):
+        super().__init__(typ)
+        self.inner = inner
+
+    def size(self) -> int:
+        if isinstance(self.typ, T.StructType) and not self.typ.is_varlen():
+            return self.typ.size()
+        return sum(a.size() for a in self.inner)
+
+
+class UnionArg(Arg):
+    """One selected option of a union."""
+
+    __slots__ = ("option", "option_typ")
+
+    def __init__(self, typ: T.Type, option: "Arg", option_typ: T.Type):
+        super().__init__(typ)
+        self.option = option
+        self.option_typ = option_typ
+
+    def size(self) -> int:
+        u = self.typ
+        if isinstance(u, T.UnionType) and not u.is_varlen():
+            return u.size()
+        return self.option.size()
+
+
+class ReturnArg(Arg):
+    """Placeholder for a call's return value; target of ResultArg links."""
+
+    __slots__ = ()
+
+
+@dataclass
+class Call:
+    meta: T.Syscall
+    args: list[Arg]
+    ret: Optional[ReturnArg] = None
+
+
+@dataclass
+class Prog:
+    calls: list[Call] = field(default_factory=list)
+
+    def __len__(self) -> int:
+        return len(self.calls)
+
+
+# ---------------------------------------------------------------------------
+# Tree walking
+
+
+def foreach_subarg(arg: Arg, fn: Callable[[Arg, "Arg | None"], None],
+                   parent: "Arg | None" = None) -> None:
+    """Depth-first visit of arg and everything beneath it.
+    fn(node, parent); pointees/options/children are all visited."""
+    fn(arg, parent)
+    if isinstance(arg, PointerArg):
+        if arg.res is not None:
+            foreach_subarg(arg.res, fn, arg)
+    elif isinstance(arg, GroupArg):
+        for a in arg.inner:
+            foreach_subarg(a, fn, arg)
+    elif isinstance(arg, UnionArg):
+        foreach_subarg(arg.option, fn, arg)
+
+
+def foreach_arg(call: Call, fn: Callable[[Arg, "Arg | None"], None]) -> None:
+    for a in call.args:
+        foreach_subarg(a, fn)
+
+
+def all_args(call: Call) -> Iterator[Arg]:
+    out: list[Arg] = []
+    foreach_arg(call, lambda a, _p: out.append(a))
+    return iter(out)
+
+
+# ---------------------------------------------------------------------------
+# Default (simplest) args — used by minimization and as mutation fallback.
+
+
+def default_arg(t: T.Type) -> Arg:
+    """The simplest well-formed arg for a type (ref prog.defaultArg)."""
+    if isinstance(t, T.PtrType):
+        if t.optional:
+            return PointerArg(t, 0, 0, 0, None)  # null
+        return PointerArg(t, 0, 0, 0, default_arg(t.elem) if t.elem is not None
+                          else DataArg(_blob_type(t), b""))
+    if isinstance(t, T.VmaType):
+        return PointerArg(t, 0, 0, 1, None)
+    if isinstance(t, T.BufferType):
+        sz = t.fixed_size()
+        if t.kind == T.BufferKind.STRING and t.values and len(t.values) == 1:
+            data = t.values[0].encode()
+            if t.str_length:
+                data = data.ljust(t.str_length, b"\x00")[: t.str_length]
+            else:
+                data += b"\x00"
+            return DataArg(t, data)
+        return DataArg(t, bytes(sz or 0))
+    if isinstance(t, T.ArrayType):
+        if t.kind == T.ArrayKind.RANGE_LEN and t.range_begin == t.range_end:
+            return GroupArg(t, [default_arg(t.elem) for _ in range(t.range_begin)])
+        return GroupArg(t, [])
+    if isinstance(t, T.StructType):
+        return GroupArg(t, [default_arg(f) for f in t.fields])
+    if isinstance(t, T.UnionType):
+        opt = t.options[0]
+        return UnionArg(t, default_arg(opt), opt)
+    if isinstance(t, T.ResourceType):
+        return ResultArg(t, None, t.default())
+    # Scalars: const/int/flags/proc/len.
+    return ConstArg(t, t.default())
+
+
+def _blob_type(ptr: T.PtrType) -> T.BufferType:
+    return T.BufferType(name="blob", dir=ptr.dir, kind=T.BufferKind.BLOB_RAND)
+
+
+def default_call(meta: T.Syscall) -> Call:
+    c = Call(meta, [default_arg(a) for a in meta.args])
+    if meta.ret is not None:
+        c.ret = ReturnArg(meta.ret)
+    return c
+
+
+# ---------------------------------------------------------------------------
+# Tree surgery (ref prog/prog.go:174-245).  All of these keep uses-links
+# consistent: removing a subtree detaches every ResultArg in it from its
+# target, and rewrites every external reference INTO it to a literal.
+
+
+def _detach_subtree(arg: Arg) -> None:
+    """Sever all cross-links of a subtree being removed from a prog."""
+
+    def fix(a: Arg, _p):
+        # References FROM the removed subtree to surviving args.
+        if isinstance(a, ResultArg) and a.res is not None:
+            a.res.uses.discard(a)
+            a.res = None
+        # References INTO the removed subtree from surviving args.
+        for user in list(a.uses):
+            user.res = None
+            user.val = user.typ.default() if hasattr(user.typ, "default") else 0
+        a.uses.clear()
+
+    foreach_subarg(arg, fix)
+    if isinstance(arg, ReturnArg):
+        for user in list(arg.uses):
+            user.res = None
+            user.val = 0
+        arg.uses.clear()
+
+
+def replace_arg(call: Call, old: Arg, new: Arg) -> None:
+    """Replace old with new anywhere in call's arg tree; old's subtree is
+    detached, and uses of old transfer to new."""
+    for user in list(old.uses):
+        user.res = new
+        new.uses.add(user)
+        old.uses.discard(user)
+    _detach_subtree(old)
+
+    def sub(args: list[Arg]) -> bool:
+        for i, a in enumerate(args):
+            if a is old:
+                args[i] = new
+                return True
+            if isinstance(a, PointerArg) and a.res is old:
+                a.res = new
+                return True
+            if isinstance(a, UnionArg):
+                if a.option is old:
+                    a.option = new
+                    return True
+                if sub([a.option]):
+                    return True
+            if isinstance(a, PointerArg) and a.res is not None:
+                if sub([a.res]):
+                    return True
+            if isinstance(a, GroupArg) and sub(a.inner):
+                return True
+        return False
+
+    if not sub(call.args):
+        raise ValueError("replace_arg: old arg not found in call")
+
+
+def remove_call(p: Prog, idx: int) -> None:
+    """Remove call idx, rewriting all references to its results."""
+    c = p.calls[idx]
+    for a in c.args:
+        _detach_subtree(a)
+    if c.ret is not None:
+        _detach_subtree(c.ret)
+    del p.calls[idx]
+
+
+def insert_before(p: Prog, idx: int, calls: list[Call]) -> None:
+    p.calls[idx:idx] = calls
+
+
+# ---------------------------------------------------------------------------
+# Clone (ref prog/clone.go:6-50): deep copy preserving result cross-links.
+
+
+def clone_prog(p: Prog) -> Prog:
+    argmap: dict[int, Arg] = {}
+    fixups: list[ResultArg] = []
+
+    def cl(a: Arg) -> Arg:
+        if isinstance(a, ConstArg):
+            n: Arg = ConstArg(a.typ, a.val)
+        elif isinstance(a, ResultArg):
+            n = ResultArg.__new__(ResultArg)
+            Arg.__init__(n, a.typ)
+            n.res, n.val, n.op_div, n.op_add = a.res, a.val, a.op_div, a.op_add
+            fixups.append(n)
+        elif isinstance(a, PointerArg):
+            n = PointerArg(a.typ, a.page, a.offset, a.npages,
+                           cl(a.res) if a.res is not None else None)
+        elif isinstance(a, PageSizeArg):
+            n = PageSizeArg(a.typ, a.npages)
+        elif isinstance(a, DataArg):
+            n = DataArg(a.typ, a.data)
+        elif isinstance(a, GroupArg):
+            n = GroupArg(a.typ, [cl(x) for x in a.inner])
+        elif isinstance(a, UnionArg):
+            n = UnionArg(a.typ, cl(a.option), a.option_typ)
+        elif isinstance(a, ReturnArg):
+            n = ReturnArg(a.typ)
+        else:
+            raise TypeError(f"clone: unknown arg {type(a)}")
+        argmap[id(a)] = n
+        return n
+
+    np_ = Prog()
+    for c in p.calls:
+        nc = Call(c.meta, [cl(a) for a in c.args])
+        if c.ret is not None:
+            nc.ret = cl(c.ret)  # type: ignore[assignment]
+        np_.calls.append(nc)
+    for ra in fixups:
+        if ra.res is not None:
+            tgt = argmap.get(id(ra.res))
+            if tgt is None:
+                raise ValueError("clone: dangling result reference")
+            ra.res = tgt
+            tgt.uses.add(ra)
+    return np_

@@ -1,0 +1,357 @@
+"""Program mutation and minimization.
+
+Capability parity with reference prog/mutation.go: corpus splice (:17-22),
+weighted insert-call/mutate-arg/remove-call loop (:26-208), per-type arg
+mutation (:71-180), the byte/word `mutateData` operator set (:505-662),
+`Minimize` with call removal + per-arg recursive simplification and a
+tried-paths memo (:223-405), and `TrimAfter` (:407).
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+from syzkaller_tpu.prog import analysis
+from syzkaller_tpu.prog import model as M
+from syzkaller_tpu.prog.analysis import State
+from syzkaller_tpu.prog.rand import Gen, Rand
+from syzkaller_tpu.sys import types as T
+from syzkaller_tpu.sys.table import SyscallTable
+
+
+def mutate(p: M.Prog, rand: Rand, table: SyscallTable, ncalls: int = 30,
+           choice_table=None, corpus: "list[M.Prog] | None" = None,
+           pid: int = 0) -> None:
+    """Mutate p in place.  The original must be cloned by the caller if it
+    needs preserving (the fuzzer clones corpus programs before mutating,
+    ref syz-fuzzer/fuzzer.go:224-229)."""
+    r = rand
+    first = True
+    while first or r.one_of(2):
+        first = False
+        if corpus and r.one_of(100):
+            _splice(p, rand, corpus, ncalls)
+            continue
+        which = r.choose_weighted([20, 10, 1])
+        if which == 0 and len(p.calls) < ncalls:
+            _insert_call(p, rand, table, choice_table, pid)
+        elif which == 1 and p.calls:
+            _mutate_arg(p, rand, table, choice_table, pid)
+        elif which == 2 and len(p.calls) > 1:
+            M.remove_call(p, r.intn(len(p.calls)))
+    while len(p.calls) > ncalls:
+        M.remove_call(p, len(p.calls) - 1)
+    if not p.calls:
+        # Never leave an empty program behind.
+        state = State(table)
+        gen = Gen(rand, state, table, choice_table, pid)
+        p.calls.extend(gen.generate_call(-1))
+
+
+def _splice(p: M.Prog, rand: Rand, corpus: list[M.Prog], ncalls: int) -> None:
+    other = M.clone_prog(corpus[rand.intn(len(corpus))])
+    idx = rand.intn(len(p.calls) + 1)
+    p.calls[idx:idx] = other.calls
+    while len(p.calls) > ncalls:
+        M.remove_call(p, len(p.calls) - 1)
+
+
+def _insert_call(p: M.Prog, rand: Rand, table: SyscallTable,
+                 choice_table, pid: int) -> None:
+    idx = rand.biased_rand(len(p.calls) + 1, 5)  # bias toward the tail
+    state = State(table)
+    for c in p.calls[:idx]:
+        state.analyze_call(c)
+    gen = Gen(rand, state, table, choice_table, pid)
+    prev = p.calls[idx - 1].meta.id if idx > 0 else -1
+    M.insert_before(p, idx, gen.generate_call(prev))
+
+
+def _mutable_args(c: M.Call) -> list[M.Arg]:
+    """Args worth pointing the mutator at (ref mutationArgs
+    prog/mutation.go:422-460): skip immutable consts/lens/pads and
+    zero-information nodes."""
+    out: list[M.Arg] = []
+
+    def visit(a: M.Arg, _p):
+        t = a.typ
+        if T.is_pad(t) or isinstance(t, (T.ConstType, T.LenType)):
+            return
+        if isinstance(a, (M.ReturnArg, M.PageSizeArg)):
+            return
+        if isinstance(a, M.GroupArg) and not isinstance(t, T.ArrayType):
+            return  # mutate struct fields individually, not the struct
+        if t.dir == T.Dir.OUT and not isinstance(t, T.ResourceType):
+            return
+        out.append(a)
+
+    M.foreach_arg(c, visit)
+    return out
+
+
+def _mutate_arg(p: M.Prog, rand: Rand, table: SyscallTable,
+                choice_table, pid: int) -> None:
+    r = rand
+    for _ in range(10):
+        ci = r.intn(len(p.calls))
+        c = p.calls[ci]
+        cands = _mutable_args(c)
+        if cands:
+            break
+    else:
+        return
+    a = cands[r.intn(len(cands))]
+    state = State(table)
+    for cc in p.calls[:ci]:
+        state.analyze_call(cc)
+    gen = Gen(rand, state, table, choice_table, pid)
+    extra = _mutate_one(a, c, gen)
+    if extra:
+        M.insert_before(p, ci, extra)
+    analysis.assign_sizes_call(c)
+    analysis.sanitize_call(c)
+
+
+def _mutate_one(a: M.Arg, c: M.Call, gen: Gen) -> list[M.Call]:
+    """Mutate one arg node; returns prerequisite calls to insert before c
+    (ref per-type mutation prog/mutation.go:71-180)."""
+    r = gen.r
+    t = a.typ
+    if isinstance(a, M.ConstArg):
+        if isinstance(t, T.FlagsType):
+            a.val = gen.flags_value(t.vals)
+        elif isinstance(t, T.ProcType):
+            a.val = r.intn(max(1, t.values_per_proc))
+        elif isinstance(t, T.IntType) and t.kind == T.IntKind.RANGE:
+            a.val = gen._signed_range(t)
+        else:
+            which = r.intn(3)
+            if which == 0:
+                a.val = gen.rand_int(getattr(t, "type_size", 8))
+            elif which == 1:
+                delta = r.intn(16) + 1
+                a.val = (a.val + (delta if r.bin() else -delta)) % (1 << 64)
+            else:
+                a.val ^= 1 << r.intn(64)
+        return []
+    if isinstance(a, M.DataArg):
+        data = bytearray(a.data)
+        mutate_data(r, data, t)
+        a.data = bytes(data)
+        return []
+    if isinstance(a, M.ResultArg):
+        na, calls = gen.resource_arg(t)  # type: ignore[arg-type]
+        M.replace_arg(c, a, na)
+        return calls
+    if isinstance(a, M.UnionArg):
+        ut = t
+        assert isinstance(ut, T.UnionType)
+        opt = ut.options[r.intn(len(ut.options))]
+        na, calls = gen.generate_arg(opt)
+        M.replace_arg(c, a, M.UnionArg(ut, na, opt))
+        return calls
+    if isinstance(a, M.PointerArg):
+        if a.npages:  # vma
+            page, calls = gen.alloc_vma(a.npages)
+            a.page, a.offset = page, 0
+            return calls
+        na, calls = gen.generate_arg(t)
+        M.replace_arg(c, a, na)
+        return calls
+    if isinstance(a, M.GroupArg) and isinstance(t, T.ArrayType):
+        calls: list[M.Call] = []
+        lo, hi = 0, 10
+        if t.kind == T.ArrayKind.RANGE_LEN:
+            lo, hi = t.range_begin, t.range_end
+        if lo == hi and a.inner:  # fixed count: mutate an element instead
+            i = r.intn(len(a.inner))
+            return _mutate_one(a.inner[i], c, gen)
+        if a.inner and len(a.inner) > lo and r.bin():
+            i = r.intn(len(a.inner))
+            M._detach_subtree(a.inner[i])
+            del a.inner[i]
+        elif len(a.inner) < hi:
+            na, calls = gen.generate_arg(t.elem)
+            a.inner.insert(r.intn(len(a.inner) + 1), na)
+        return calls
+    # Fallback: regenerate wholesale.
+    na, calls = gen.generate_arg(t)
+    M.replace_arg(c, a, na)
+    return calls
+
+
+# ---------------------------------------------------------------------------
+# Buffer data mutation (ref mutateData prog/mutation.go:505-662).
+
+
+def mutate_data(r: Rand, data: bytearray, t: "T.Type | None" = None) -> None:
+    retry = True
+    while retry or r.one_of(2):
+        retry = False
+        if not data:
+            data.extend(r.bytes(r.intn(16) + 1))
+            continue
+        op = r.intn(10)
+        i = r.intn(len(data))
+        if op == 0:    # flip bit
+            data[i] ^= 1 << r.intn(8)
+        elif op == 1:  # random byte
+            data[i] = r.intn(256)
+        elif op == 2:  # special byte
+            data[i] = (0, 0xFF, 0x7F, 0x80)[r.intn(4)]
+        elif op == 3:  # add/sub small delta on a byte
+            data[i] = (data[i] + r.intn(35) - 17) % 256
+        elif op == 4 and len(data) >= 2:  # swap two bytes
+            j = r.intn(len(data))
+            data[i], data[j] = data[j], data[i]
+        elif op == 5:  # add/sub on a word/dword/qword (LE)
+            w = (2, 4, 8)[r.intn(3)]
+            if i + w <= len(data):
+                v = int.from_bytes(data[i:i + w], "little")
+                v = (v + r.intn(35) - 17) % (1 << (8 * w))
+                data[i:i + w] = v.to_bytes(w, "little")
+        elif op == 6:  # insert random bytes
+            ins = r.bytes(r.intn(8) + 1)
+            data[i:i] = ins
+        elif op == 7 and len(data) > 1:  # remove a span
+            n = r.intn(len(data) - 1) + 1
+            del data[i:i + n]
+        elif op == 8:  # duplicate a span
+            n = r.intn(min(len(data) - i, 16)) + 1
+            data[i:i] = data[i:i + n]
+        elif op == 9:  # append
+            data.extend(r.bytes(r.intn(16) + 1))
+        # Respect fixed-size buffers: restore length.
+        if isinstance(t, T.BufferType):
+            fs = t.fixed_size()
+            if fs is not None:
+                if len(data) > fs:
+                    del data[fs:]
+                else:
+                    data.extend(bytes(fs - len(data)))
+
+
+# ---------------------------------------------------------------------------
+# Minimization (ref Minimize prog/mutation.go:223-405).
+
+Pred = Callable[[M.Prog, int], bool]
+
+
+def minimize(p: M.Prog, call_index: int, pred: Pred,
+             crash_mode: bool = False) -> tuple[M.Prog, int]:
+    """Shrink p while pred(p, call_index) stays true.  pred re-executes the
+    candidate (dozens of kernel round-trips — ref fuzzer.go:421-435); the
+    tried-paths memo keeps the number of attempts linear-ish."""
+    p = M.clone_prog(p)
+    # 1. Call removal, from the end (later calls can't be depended on).
+    i = len(p.calls) - 1
+    while i >= 0:
+        if i != call_index:
+            q = M.clone_prog(p)
+            M.remove_call(q, i)
+            ni = call_index - 1 if i < call_index else call_index
+            if pred(q, ni):
+                p, call_index = q, ni
+        i -= 1
+    # 2. Per-arg simplification on every remaining call.
+    tried: set[tuple] = set()
+    progress = True
+    while progress:
+        progress = False
+        for ci in range(len(p.calls)):
+            # Paths are enumerated against the current p; as soon as a
+            # simplification lands, restart enumeration — the old paths
+            # are stale against the new tree.
+            for path, simplify in _simplifications(p.calls[ci]):
+                key = (ci, path, simplify.__name__)
+                if key in tried:
+                    continue
+                tried.add(key)
+                q = M.clone_prog(p)
+                if not simplify(q.calls[ci], _arg_at(q.calls[ci], path)):
+                    continue
+                analysis.assign_sizes_call(q.calls[ci])
+                if pred(q, call_index):
+                    p = q
+                    progress = True
+                    break
+            if progress:
+                break
+    return p, call_index
+
+
+def _arg_paths(c: M.Call):
+    """Yield (path, arg) for every node; path = child-index tuple."""
+
+    def rec(a: M.Arg, path: tuple):
+        yield path, a
+        if isinstance(a, M.PointerArg) and a.res is not None:
+            yield from rec(a.res, path + (0,))
+        elif isinstance(a, M.GroupArg):
+            for i, x in enumerate(a.inner):
+                yield from rec(x, path + (i,))
+        elif isinstance(a, M.UnionArg):
+            yield from rec(a.option, path + (0,))
+
+    for i, a in enumerate(c.args):
+        yield from rec(a, (i,))
+
+
+def _arg_at(c: M.Call, path: tuple) -> M.Arg:
+    a: M.Arg = c.args[path[0]]
+    for idx in path[1:]:
+        if isinstance(a, M.PointerArg):
+            a = a.res  # type: ignore[assignment]
+        elif isinstance(a, M.GroupArg):
+            a = a.inner[idx]
+        elif isinstance(a, M.UnionArg):
+            a = a.option
+    return a
+
+
+def _simplify_default(c: M.Call, a: M.Arg) -> bool:
+    if isinstance(a, (M.ReturnArg, M.PageSizeArg)):
+        return False
+    if isinstance(a, M.ConstArg) and a.val == a.typ.default():
+        return False
+    if isinstance(a, M.PointerArg) and a.is_null:
+        return False
+    M.replace_arg(c, a, M.default_arg(a.typ))
+    return True
+
+
+def _simplify_halve_data(c: M.Call, a: M.Arg) -> bool:
+    if not isinstance(a, M.DataArg) or len(a.data) <= 1:
+        return False
+    if isinstance(a.typ, T.BufferType) and a.typ.fixed_size() is not None:
+        return False
+    a.data = a.data[: len(a.data) // 2]
+    return True
+
+
+def _simplify_halve_array(c: M.Call, a: M.Arg) -> bool:
+    if not isinstance(a, M.GroupArg) or not isinstance(a.typ, T.ArrayType):
+        return False
+    t = a.typ
+    lo = t.range_begin if t.kind == T.ArrayKind.RANGE_LEN else 0
+    if len(a.inner) <= max(lo, 1) - (0 if lo else 1) or len(a.inner) <= lo:
+        return False
+    keep = max(lo, len(a.inner) // 2)
+    if keep >= len(a.inner):
+        return False
+    for x in a.inner[keep:]:
+        M._detach_subtree(x)
+    del a.inner[keep:]
+    return True
+
+
+def _simplifications(c: M.Call):
+    for path, a in list(_arg_paths(c)):
+        for fn in (_simplify_default, _simplify_halve_data, _simplify_halve_array):
+            yield path, fn
+
+
+def trim_after(p: M.Prog, idx: int) -> None:
+    """Drop all calls after idx (ref TrimAfter prog/mutation.go:407)."""
+    for i in range(len(p.calls) - 1, idx, -1):
+        M.remove_call(p, i)

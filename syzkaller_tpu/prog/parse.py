@@ -1,0 +1,67 @@
+"""Execution-log parsing: recover programs from fuzzer/crash logs.
+
+Capability parity with reference prog/parse.go:19-68 (ParseLog): split a
+console/crash log on "executing program N:" markers, deserialize each
+block, and keep the per-proc attribution so repro can identify suspects
+(ref repro/repro.go:136-148).
+"""
+
+from __future__ import annotations
+
+import re
+from dataclasses import dataclass
+
+from syzkaller_tpu.prog import encoding
+from syzkaller_tpu.prog import model as M
+from syzkaller_tpu.sys.table import SyscallTable
+
+_MARKER = re.compile(rb"executing program (\d+):")
+
+
+@dataclass
+class LogEntry:
+    prog: M.Prog
+    proc: int      # which fuzzer proc executed it
+    start: int     # byte offset of the marker in the log
+    end: int       # byte offset just past the program text
+
+
+def parse_log(data: bytes, table: SyscallTable) -> list[LogEntry]:
+    out: list[LogEntry] = []
+    matches = list(_MARKER.finditer(data))
+    for i, m in enumerate(matches):
+        start = m.end()
+        end = matches[i + 1].start() if i + 1 < len(matches) else len(data)
+        block = data[start:end]
+        lines = []
+        consumed = start
+        for raw in block.splitlines(keepends=True):
+            line = raw.strip()
+            if line and not _looks_like_prog_line(line):
+                break
+            consumed += len(raw)
+            if line:
+                lines.append(line.decode(errors="replace"))
+        if not lines:
+            continue
+        try:
+            prog = encoding.deserialize("\n".join(lines).encode(), table)
+        except encoding.DeserializeError:
+            continue
+        if prog.calls:
+            out.append(LogEntry(prog=prog, proc=int(m.group(1)),
+                                start=m.start(), end=consumed))
+    return out
+
+
+def _looks_like_prog_line(line: bytes) -> bool:
+    # call lines are "name(...)" or "rN = name(...)"; console noise isn't.
+    head = line.split(b"(", 1)[0]
+    if b"(" not in line:
+        return False
+    if b"=" in head:
+        lhs, _, rhs = head.partition(b"=")
+        head = rhs.strip()
+        if not re.fullmatch(rb"r\d+", lhs.strip()):
+            return False
+    return re.fullmatch(rb"[a-zA-Z_][\w$]*", head.strip()) is not None

@@ -1,0 +1,132 @@
+"""Call-pair priorities and the choice table (host reference version).
+
+Capability parity with reference prog/prio.go: CalculatePriorities =
+static ⊙ dynamic (:29-38), static priorities from shared resource /
+pointer / filename usage (:40-135), dynamic priorities from pairwise
+corpus co-occurrence (:137-154), normalization to [0.1, 1] (:158-192),
+prefix-sum choice-table rows ×1000 (:202-228) and binary-search Choose
+with rejection of disabled calls (:230-249).
+
+This numpy implementation is the semantic reference; the device version
+(syzkaller_tpu/cover/engine.py) holds the same prefix-sum matrix
+device-resident and draws whole batches of (prev_call → next_call)
+decisions in one jit call — prio.go:230-249 vectorized, per the
+BASELINE north star.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from syzkaller_tpu.prog import model as M
+from syzkaller_tpu.prog.rand import Rand
+from syzkaller_tpu.sys import types as T
+from syzkaller_tpu.sys.table import SyscallTable
+
+
+def static_priorities(table: SyscallTable) -> np.ndarray:
+    """Pairwise affinity from shared type usage.  Uses are weighted like
+    the reference (prio.go:40-135): writing a resource is worth more than
+    reading one; generic types (pointers, filenames) are weak signals."""
+    n = table.count
+    # kind-chain-prefix -> accumulated [uses_as_input, produces] per call
+    uses: dict[tuple, np.ndarray] = {}
+
+    def note(cid: int, key: tuple, w_in: float, w_out: float):
+        m = uses.setdefault(key, np.zeros((n, 2), dtype=np.float32))
+        m[cid, 0] += w_in
+        m[cid, 1] += w_out
+
+    for c in table.calls:
+        def visit(t: T.Type, cid=c.id):
+            if isinstance(t, T.ResourceType):
+                # every prefix of the kind chain creates affinity, weaker
+                # for more generic prefixes
+                chain = t.desc.kind
+                for plen in range(1, len(chain) + 1):
+                    w = 0.3 + 0.7 * plen / len(chain)
+                    if t.dir == T.Dir.IN:
+                        note(cid, chain[:plen], w, 0.0)
+                    else:
+                        note(cid, chain[:plen], 0.0, w)
+            elif isinstance(t, T.BufferType) and t.kind == T.BufferKind.FILENAME:
+                note(cid, ("<filename>",), 0.5, 0.5)
+            elif isinstance(t, T.VmaType):
+                note(cid, ("<vma>",), 0.3, 0.3)
+
+        T.foreach_type(c, visit)
+
+    prios = np.zeros((n, n), dtype=np.float32)
+    for m in uses.values():
+        # call i producing what call j consumes (and vice versa) => affinity
+        prios += np.outer(m[:, 1], m[:, 0])
+        prios += np.outer(m[:, 0], m[:, 1]) * 0.5
+        prios += np.outer(m[:, 0], m[:, 0]) * 0.3
+    # Same call-name variants attract each other.
+    by_name: dict[str, list[int]] = {}
+    for c in table.calls:
+        by_name.setdefault(c.call_name, []).append(c.id)
+    for ids in by_name.values():
+        for i in ids:
+            for j in ids:
+                prios[i, j] += 1.0
+    return _normalize(prios)
+
+
+def dynamic_priorities(corpus: "list[M.Prog]", ncalls: int) -> np.ndarray:
+    """Co-occurrence counts over the corpus (prio.go:137-154)."""
+    prios = np.zeros((ncalls, ncalls), dtype=np.float32)
+    for p in corpus:
+        ids = sorted({c.meta.id for c in p.calls})
+        for i in ids:
+            for j in ids:
+                if i != j:
+                    prios[i, j] += 1.0
+    # Dampen: sqrt keeps a few hot pairs from dominating.
+    return _normalize(np.sqrt(prios))
+
+
+def _normalize(prios: np.ndarray) -> np.ndarray:
+    """Row-normalize to [0.1, 1] (prio.go:158-192): every pair keeps a
+    floor probability so nothing is starved."""
+    out = np.empty_like(prios)
+    for i in range(prios.shape[0]):
+        row = prios[i]
+        mx = row.max()
+        out[i] = 0.1 + 0.9 * (row / mx) if mx > 0 else 1.0
+    return out
+
+
+def calculate_priorities(table: SyscallTable,
+                         corpus: "list[M.Prog] | None" = None) -> np.ndarray:
+    st = static_priorities(table)
+    if corpus:
+        dyn = dynamic_priorities(corpus, table.count)
+        return st * dyn
+    return st
+
+
+class ChoiceTable:
+    """Prefix-sum sampling table (prio.go:202-249)."""
+
+    def __init__(self, prios: np.ndarray, enabled: "set[int] | None" = None,
+                 ncalls: "int | None" = None):
+        n = ncalls if ncalls is not None else prios.shape[0]
+        self.enabled = set(range(n)) if enabled is None else set(enabled)
+        mask = np.zeros(n, dtype=np.float32)
+        for i in self.enabled:
+            mask[i] = 1.0
+        scaled = np.round(prios * 1000.0) * mask[None, :]
+        self.run = np.cumsum(scaled, axis=1).astype(np.int64)  # (n, n) prefix sums
+        self.enabled_list = sorted(self.enabled)
+
+    def choose(self, r: Rand, prev_call_id: int = -1) -> int:
+        if prev_call_id < 0 or self.run[prev_call_id, -1] == 0:
+            return self.enabled_list[r.intn(len(self.enabled_list))]
+        row = self.run[prev_call_id]
+        for _ in range(100):
+            x = r.intn(int(row[-1])) + 1
+            idx = int(np.searchsorted(row, x))
+            if idx in self.enabled:
+                return idx
+        return self.enabled_list[r.intn(len(self.enabled_list))]

@@ -1,0 +1,87 @@
+"""Program invariant checking (debug builds / tests).
+
+Capability parity with reference prog/validation.go:17-30: arg shape vs
+type, bidirectional uses-links, result refs only point backward, page
+ranges, fixed-size data lengths.
+"""
+
+from __future__ import annotations
+
+from syzkaller_tpu.prog import model as M
+from syzkaller_tpu.sys import types as T
+
+
+class ValidationError(Exception):
+    pass
+
+
+def validate(p: M.Prog) -> None:
+    seen: set[int] = set()  # ids of args defined so far (for backward refs)
+    for ci, c in enumerate(p.calls):
+        if len(c.args) != len(c.meta.args):
+            raise ValidationError(f"call {ci} {c.meta.name}: arg count")
+        for a, t in zip(c.args, c.meta.args):
+            _validate_arg(a, t, ci, seen)
+        if c.ret is not None:
+            if not isinstance(c.ret, M.ReturnArg):
+                raise ValidationError(f"call {ci}: ret is {type(c.ret)}")
+            _check_uses(c.ret, ci)
+            seen.add(id(c.ret))
+        elif c.meta.ret is not None:
+            raise ValidationError(f"call {ci} {c.meta.name}: missing ret")
+
+
+def _check_uses(a: M.Arg, ci: int) -> None:
+    for u in a.uses:
+        if not isinstance(u, M.ResultArg):
+            raise ValidationError(f"call {ci}: non-result arg in uses")
+        if u.res is not a:
+            raise ValidationError(f"call {ci}: uses link not bidirectional")
+
+
+def _validate_arg(a: M.Arg, t: T.Type, ci: int, seen: set[int]) -> None:
+    if a.typ is not t and a.typ.name != t.name:
+        # Union options / ptr elems share declarations; require same object
+        # except for directional struct copies, where name equality holds.
+        raise ValidationError(
+            f"call {ci}: arg type {a.typ.name} != decl {t.name}")
+    _check_uses(a, ci)
+    if isinstance(a, M.ResultArg):
+        if a.res is not None and id(a.res) not in seen:
+            raise ValidationError(f"call {ci}: forward/dangling result ref")
+    elif isinstance(a, M.PointerArg):
+        if a.page < 0 or a.page + max(a.npages, 1) > M.MAX_PAGES:
+            raise ValidationError(f"call {ci}: page {a.page} out of range")
+        if a.res is not None:
+            if not isinstance(t, T.PtrType):
+                raise ValidationError(f"call {ci}: pointee under {t.name}")
+            elem = t.elem if t.elem is not None else a.res.typ
+            _validate_arg(a.res, elem, ci, seen)
+    elif isinstance(a, M.DataArg):
+        if isinstance(t, T.BufferType):
+            fs = t.fixed_size()
+            if fs is not None and len(a.data) != fs:
+                raise ValidationError(
+                    f"call {ci}: fixed buffer {t.name} len {len(a.data)} != {fs}")
+    elif isinstance(a, M.GroupArg):
+        if isinstance(t, T.StructType):
+            if len(a.inner) != len(t.fields):
+                raise ValidationError(f"call {ci}: struct {t.name} field count")
+            for x, f in zip(a.inner, t.fields):
+                _validate_arg(x, f, ci, seen)
+        elif isinstance(t, T.ArrayType):
+            if t.kind == T.ArrayKind.RANGE_LEN and t.range_begin == t.range_end \
+                    and len(a.inner) != t.range_begin:
+                raise ValidationError(f"call {ci}: fixed array {t.name} count")
+            for x in a.inner:
+                _validate_arg(x, t.elem, ci, seen)
+        else:
+            raise ValidationError(f"call {ci}: group under {t.name}")
+    elif isinstance(a, M.UnionArg):
+        if not isinstance(t, T.UnionType):
+            raise ValidationError(f"call {ci}: union under {t.name}")
+        if all(o is not a.option_typ and o.field_name() != a.option_typ.field_name()
+               for o in t.options):
+            raise ValidationError(f"call {ci}: unknown union option")
+        _validate_arg(a.option, a.option_typ, ci, seen)
+    seen.add(id(a))

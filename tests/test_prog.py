@@ -1,0 +1,310 @@
+"""Program-layer property tests.
+
+Strategy mirrors the reference prog test suite (SURVEY §4.1,
+prog/prog_test.go:15-54, mutation_test.go, encodingexec_test.go):
+seeded massive-iteration roundtrips with the seed logged for replay.
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+from syzkaller_tpu import prog as P
+from syzkaller_tpu.prog import encodingexec, model as M, prio
+from syzkaller_tpu.sys import types as T
+from syzkaller_tpu.sys.table import load_table
+
+ITERS = int(os.environ.get("SYZ_TEST_ITERS", "150"))
+
+
+@pytest.fixture(scope="module")
+def table():
+    return load_table(files=["probe.txt"])
+
+
+@pytest.fixture(scope="module")
+def full_table():
+    return load_table()
+
+
+def seeded_rand(rng):
+    return P.Rand(np.random.default_rng(int(rng.integers(0, 2**31))))
+
+
+def test_generate_valid(table, rng):
+    for i in range(ITERS):
+        r = P.Rand(np.random.default_rng(i))
+        p = P.generate(r, table, ncalls=12)
+        assert 0 < len(p.calls) <= 12
+        P.validate(p)
+
+
+def test_generate_full_table(full_table, rng):
+    for i in range(ITERS // 3):
+        r = P.Rand(np.random.default_rng(1000 + i))
+        p = P.generate(r, full_table, ncalls=20)
+        P.validate(p)
+
+
+def test_serialize_roundtrip(table):
+    for i in range(ITERS):
+        r = P.Rand(np.random.default_rng(i))
+        p = P.generate(r, table, ncalls=10)
+        data = P.serialize(p)
+        p2 = P.deserialize(data, table)
+        P.validate(p2)
+        assert P.serialize(p2) == data, f"seed {i}:\n{data.decode()}"
+
+
+def test_clone_preserves_serialization(table):
+    for i in range(ITERS):
+        r = P.Rand(np.random.default_rng(i))
+        p = P.generate(r, table, ncalls=10)
+        q = M.clone_prog(p)
+        P.validate(q)
+        assert P.serialize(q) == P.serialize(p)
+
+
+def test_mutate_does_not_touch_original(table):
+    for i in range(ITERS):
+        r = P.Rand(np.random.default_rng(i))
+        p = P.generate(r, table, ncalls=8)
+        before = P.serialize(p)
+        q = M.clone_prog(p)
+        P.mutate(q, r, table, ncalls=12)
+        P.validate(q)
+        assert P.serialize(p) == before, f"seed {i}"
+
+
+def test_mutate_changes_prog(table):
+    changed = 0
+    for i in range(50):
+        r = P.Rand(np.random.default_rng(i))
+        p = P.generate(r, table, ncalls=8)
+        q = M.clone_prog(p)
+        P.mutate(q, r, table, ncalls=12)
+        if P.serialize(q) != P.serialize(p):
+            changed += 1
+    assert changed > 40  # mutation should nearly always change something
+
+
+def test_exec_serialize(table):
+    for i in range(ITERS):
+        r = P.Rand(np.random.default_rng(i))
+        p = P.generate(r, table, ncalls=10)
+        data = P.serialize_for_exec(p, pid=i % 8)
+        assert len(data) % 8 == 0
+        words = np.frombuffer(data, dtype="<u8")
+        assert words[-1] == encodingexec.INSTR_EOF
+
+
+def test_exec_serialize_golden(table):
+    # syz_probe$ints(1, 2, 3, 4, 5) — pure scalars, no copyin.
+    p = P.deserialize(b"syz_probe$ints(0x1, 0x2, 0x3, 0x4, 0x5)\n", table)
+    words = np.frombuffer(P.serialize_for_exec(p), dtype="<u8")
+    meta = table["syz_probe$ints"]
+    expect = [meta.nr, encodingexec.NO_RESULT, 5,
+              0, 8, 1, 0, 1, 2, 0, 2, 3, 0, 4, 4, 0, 8, 5,
+              encodingexec.INSTR_EOF]
+    assert list(words) == expect
+
+
+def test_exec_serialize_endian(table):
+    p = P.deserialize(
+        b'syz_probe$endian(&(0x20000000)={0x1234, 0x12345678, 0x1, 0x1, 0x0, 0x1234, 0x2})\n',
+        table)
+    data = P.serialize_for_exec(p)
+    words = np.frombuffer(data, dtype="<u8")
+    # First copyin: int16be 0x1234 -> stored as 0x3412 (LE word holding BE bytes).
+    i = list(words).index(encodingexec.INSTR_COPYIN)
+    assert words[i + 1] == M.DATA_OFFSET
+    assert words[i + 2] == encodingexec.ARG_CONST
+    assert words[i + 3] == 2
+    assert words[i + 4] == 0x3412
+
+
+def test_result_links_roundtrip(table):
+    text = (b"r0 = syz_probe$res_new()\n"
+            b"r1 = syz_probe$res_derive(r0)\n"
+            b"syz_probe$res_use(r0)\n"
+            b"syz_probe$res_use(r1)\n")
+    p = P.deserialize(text, table)
+    P.validate(p)
+    assert P.serialize(p) == text
+    # removing call 0 must rewrite the refs to literals
+    M.remove_call(p, 0)
+    P.validate(p)
+    txt = P.serialize(p).decode()
+    assert "r0 = syz_probe$res_derive" in txt
+
+
+def test_out_resource_copyout(table):
+    text = (b"r0 = syz_probe$res_new()\n"
+            b"syz_probe$res_use(r0)\n"
+            b"syz_probe$res_out(&(0x20000000)={<r1=>0x0, 0x0})\n"
+            b"syz_probe$res_use(r1)\n")
+    p = P.deserialize(text, table)
+    P.validate(p)
+    assert P.serialize(p) == text
+    words = list(np.frombuffer(P.serialize_for_exec(p), dtype="<u8"))
+    assert encodingexec.INSTR_COPYOUT in words
+    i = words.index(encodingexec.INSTR_COPYOUT)
+    # result_idx, addr, size
+    assert words[i + 2] == M.DATA_OFFSET
+    assert words[i + 3] == 4  # probe_res underlying int32
+
+
+def test_assign_sizes(table):
+    p = P.deserialize(
+        b'syz_probe$len_plain(&(0x20000000)=[0x1, 0x2, 0x3], 0x0)\n', table)
+    n = p.calls[0].args[1]
+    assert isinstance(n, M.ConstArg) and n.val == 3
+    p = P.deserialize(
+        b'syz_probe$len_bytes(&(0x20000000)=[0x1, 0x2], 0x0)\n', table)
+    assert p.calls[0].args[1].val == 16
+    p = P.deserialize(b'syz_probe$len_vma(&(0x20000000/0x2000)=nil, 0x0)\n', table)
+    assert p.calls[0].args[1].val == 0x2000
+
+
+def test_assign_sizes_words(table):
+    body = b'syz_probe$len_words(&(0x20000000)={[0x1, 0x2], 0x0, 0x0, 0x0, 0x0, 0x0, 0x0})\n'
+    p = P.deserialize(body, table)
+    grp = p.calls[0].args[0].res
+    vals = [a.val for a in grp.inner[1:6]]  # inner[6] is the trailing pad
+    assert vals == [2, 16, 8, 4, 2]  # elems, bytes, /2, /4, /8
+
+
+def test_len_parent(table):
+    p = P.deserialize(b'syz_probe$len_parent(&(0x20000000)={0x0, 0x0})\n', table)
+    grp = p.calls[0].args[0].res
+    assert grp.inner[1].val == 8  # int32 + len int32
+
+
+def test_minimize_removes_calls(table):
+    text = (b"syz_probe$ints(0x1, 0x2, 0x3, 0x4, 0x5)\n"
+            b"r0 = syz_probe$res_new()\n"
+            b"syz_probe$res_use(r0)\n")
+    p = P.deserialize(text, table)
+
+    def pred(q, ci):
+        P.validate(q)
+        return q.calls[ci].meta.name == "syz_probe$res_use"
+
+    q, ci = P.minimize(p, 2, pred)
+    assert q.calls[ci].meta.name == "syz_probe$res_use"
+    # ints call is removable; res_new may or may not be (ref kept if arg
+    # simplification to a literal passes pred — it does here).
+    assert len(q.calls) <= 2
+
+
+def test_minimize_shrinks_data(table):
+    r = P.Rand(np.random.default_rng(7))
+    big = bytes(range(256))
+    text = b'syz_probe$bufs(&(0x20000000)="%s", &(0x20001000)=\"\", 0x0)\n' % big.hex().encode()
+    p = P.deserialize(text, table)
+
+    def pred(q, ci):
+        return q.calls[ci].meta.name == "syz_probe$bufs"
+
+    q, ci = P.minimize(p, 0, pred)
+    arg = q.calls[ci].args[0]
+    # data either nulled (optional? no) or shrunk to near-zero
+    if isinstance(arg, M.PointerArg) and arg.res is not None:
+        assert len(arg.res.data) < 256
+
+
+def test_parse_log(table):
+    log = (b"[ 12.001] random console noise\n"
+           b"2026/01/01 executing program 3:\n"
+           b"r0 = syz_probe$res_new()\n"
+           b"syz_probe$res_use(r0)\n"
+           b"[ 13.37] BUG: something\n"
+           b"executing program 1:\n"
+           b"syz_probe$ints(0x1, 0x2, 0x3, 0x4, 0x5)\n")
+    entries = P.parse_log(log, table)
+    assert [e.proc for e in entries] == [3, 1]
+    assert len(entries[0].prog.calls) == 2
+    assert entries[1].prog.calls[0].meta.name == "syz_probe$ints"
+
+
+def test_trim_after(table):
+    text = (b"r0 = syz_probe$res_new()\n"
+            b"syz_probe$res_use(r0)\n"
+            b"syz_probe$ints(0x1, 0x2, 0x3, 0x4, 0x5)\n")
+    p = P.deserialize(text, table)
+    P.trim_after(p, 1)
+    assert len(p.calls) == 2
+    P.validate(p)
+
+
+def test_proc_values_disjoint(table):
+    meta = table["syz_probe$proc"]
+    a = M.ConstArg(meta.args[0], 2)
+    assert a.value(pid=0) == 20002
+    assert a.value(pid=3) == 20014  # 20000 + 3*4 + 2
+
+
+def test_choice_table(table, rng):
+    prios = prio.calculate_priorities(table)
+    assert prios.shape == (table.count, table.count)
+    assert (prios >= 0.1 - 1e-6).all() and (prios <= 1.0 + 1e-6).all()
+    enabled = {c.id for c in table.calls if "res" in c.name or c.call_name == "mmap"}
+    ct = prio.ChoiceTable(prios, enabled)
+    r = seeded_rand(rng)
+    res_new = table["syz_probe$res_new"].id
+    counts = {}
+    for _ in range(300):
+        idx = ct.choose(r, res_new)
+        assert idx in enabled
+        counts[idx] = counts.get(idx, 0) + 1
+    # res-family calls share resources with res_new => must be drawn.
+    assert counts.get(table["syz_probe$res_use"].id, 0) > 0
+
+
+def test_dynamic_priorities(table):
+    r = P.Rand(np.random.default_rng(3))
+    corpus = [P.generate(r, table, ncalls=6) for _ in range(20)]
+    prios = prio.calculate_priorities(table, corpus)
+    assert prios.shape == (table.count, table.count)
+
+
+def test_generate_with_choice_table(table):
+    prios = prio.calculate_priorities(table)
+    ct = prio.ChoiceTable(prios)
+    for i in range(30):
+        r = P.Rand(np.random.default_rng(i))
+        p = P.generate(r, table, ncalls=10, choice_table=ct)
+        P.validate(p)
+
+
+def test_device_refilled_rand(table):
+    """Rand consumes device-pushed words first, then falls back to host."""
+    r = P.Rand(np.random.default_rng(0))
+    r.refill(np.arange(100, dtype=np.uint64))
+    assert r.rand64() == 0
+    assert r.intn(7) == 1 % 7
+    p = P.generate(r, table, ncalls=5)  # drains pool, falls back, no crash
+    P.validate(p)
+
+
+def test_minimize_array_paths_no_crash(table):
+    """Regression: stale arg paths after a successful simplification must
+    not be applied to the new tree (array shrink + ptr nulling)."""
+    text = b'syz_probe$array_fixed(&(0x20000000)={0x1, 0x0, [0x1, 0x2, 0x3, 0x4], 0x2, 0x0})\n'
+    p = P.deserialize(text, table)
+    q, ci = P.minimize(p, 0, lambda q, ci: True)
+    assert q.calls[ci].meta.name == "syz_probe$array_fixed"
+
+
+def test_parse_log_bad_hex_skipped(table):
+    log = b"executing program 0:\nmmap(0x, 0x0)\n"
+    assert P.parse_log(log, table) == []
+
+
+def test_rand_bytes_word_economy():
+    r = P.Rand(np.random.default_rng(0))
+    r.refill(np.arange(64, dtype=np.uint64))
+    data = r.bytes(256)  # 256 bytes should cost 32 words, not 256
+    assert len(data) == 256
+    assert r._pos == 32
