@@ -1,0 +1,117 @@
+"""Benchmark: device-resident signal-diff + choice-sampling throughput.
+
+Measures the BASELINE.json north-star metric — coverage signal-diff +
+corpus-priority updates per second — as one fused jitted step per batch
+(pack → diff vs max cover → merge → batched ChoiceTable draw), against
+the CPU baseline doing the reference's per-exec work (sorted-set
+difference/union, cover/cover.go:42-102, + one prefix-sum Choose,
+prog/prio.go:230-249) in numpy.
+
+Prints ONE JSON line:
+  {"metric": ..., "value": N, "unit": "updates/s", "vs_baseline": N}
+"""
+
+import functools
+import json
+import time
+
+import numpy as np
+
+
+NPCS = 1 << 16      # 64k-PC bitmap (BASELINE config #2)
+NCALLS = 256
+B = 256             # execs per device step
+K = 512             # max PCs per exec (exec cover list, padded)
+NBATCH = 8          # distinct pre-generated batches, cycled
+WARM = 3
+SECONDS = 4.0
+
+
+def make_workload(rng):
+    """Steady-state-shaped coverage: each call has a hot PC region most
+    execs stay inside (little new signal), with occasional outliers."""
+    call_ids = rng.integers(0, NCALLS, size=(NBATCH, B)).astype(np.int32)
+    base = (call_ids.astype(np.int64) * 131) % (NPCS - 2048)
+    offs = rng.integers(0, 1024, size=(NBATCH, B, K))
+    rare = rng.integers(0, NPCS, size=(NBATCH, B, K))
+    hot = (rng.random((NBATCH, B, K)) < 0.995)
+    pc_idx = np.where(hot, base[:, :, None] + offs, rare).astype(np.int32)
+    valid = rng.random((NBATCH, B, K)) < 0.9
+    return call_ids, pc_idx, valid
+
+
+def bench_device(call_ids, pc_idx, valid):
+    import jax
+    import jax.numpy as jnp
+
+    from syzkaller_tpu.cover.engine import fuzz_step, nwords_for
+
+    W = nwords_for(NPCS)
+    step = jax.jit(functools.partial(fuzz_step, npcs=NPCS),
+                   donate_argnums=(0,))
+    max_cover = jnp.zeros((NCALLS, W), jnp.uint32)
+    prios = jnp.full((NCALLS, NCALLS), 0.5, jnp.float32)
+    enabled = jnp.ones((NCALLS,), jnp.bool_)
+    key = jax.random.PRNGKey(0)
+    dev_batches = [(jnp.asarray(call_ids[i]), jnp.asarray(pc_idx[i]),
+                    jnp.asarray(valid[i])) for i in range(NBATCH)]
+    for i in range(WARM):
+        ci, pi, va = dev_batches[i % NBATCH]
+        max_cover, _, has_new, nxt = step(max_cover, prios, enabled, key, ci, pi, va)
+    jax.block_until_ready(max_cover)
+
+    iters = 0
+    t0 = time.perf_counter()
+    while time.perf_counter() - t0 < SECONDS:
+        ci, pi, va = dev_batches[iters % NBATCH]
+        max_cover, _, has_new, nxt = step(max_cover, prios, enabled, key, ci, pi, va)
+        iters += 1
+    jax.block_until_ready(max_cover)
+    dt = time.perf_counter() - t0
+    return B * iters / dt
+
+
+def bench_cpu(call_ids, pc_idx, valid):
+    """Reference-shaped CPU loop: per exec, canonicalize + diff vs the
+    call's max cover, union-merge on new signal, then one ChoiceTable
+    draw by binary search over the prefix-sum row."""
+    max_cover = [np.zeros(0, np.uint32) for _ in range(NCALLS)]
+    run = np.cumsum(np.full((NCALLS, NCALLS), 500, np.int64), axis=1)
+    rng = np.random.default_rng(0)
+
+    n = 0
+    t0 = time.perf_counter()
+    deadline = t0 + SECONDS
+    while time.perf_counter() < deadline:
+        bi = n % NBATCH
+        for e in range(B):
+            cid = call_ids[bi, e]
+            cov = np.unique(pc_idx[bi, e][valid[bi, e]].astype(np.uint32))
+            diff = np.setdiff1d(cov, max_cover[cid], assume_unique=True)
+            if len(diff):
+                max_cover[cid] = np.union1d(max_cover[cid], diff)
+            row = run[cid]
+            x = rng.integers(1, row[-1] + 1)
+            np.searchsorted(row, x)
+        n += 1
+        if time.perf_counter() - t0 > SECONDS:
+            break
+    dt = time.perf_counter() - t0
+    return B * n / dt
+
+
+def main():
+    rng = np.random.default_rng(42)
+    call_ids, pc_idx, valid = make_workload(rng)
+    cpu_rate = bench_cpu(call_ids, pc_idx, valid)
+    dev_rate = bench_device(call_ids, pc_idx, valid)
+    print(json.dumps({
+        "metric": "signal_diff_prio_updates_per_sec",
+        "value": round(dev_rate, 1),
+        "unit": "updates/s",
+        "vs_baseline": round(dev_rate / cpu_rate, 3),
+    }))
+
+
+if __name__ == "__main__":
+    main()

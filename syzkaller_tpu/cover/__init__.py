@@ -1,0 +1,6 @@
+"""Coverage: device-resident bitmap engine + host sorted-set reference."""
+
+from syzkaller_tpu.cover import sets  # noqa: F401
+from syzkaller_tpu.cover.engine import (  # noqa: F401
+    CoverageEngine, nwords_for, pack_pcs, sample_calls, signal_diff,
+)

@@ -1,0 +1,368 @@
+"""Device-resident coverage + sampling engine (the TPU hot loop).
+
+This is the BASELINE north-star component: the reference's CPU hot loops
+become fixed-shape array programs that live in HBM and run under jit:
+
+  - signal diff per exec (ref cover.Difference + syz-fuzzer/fuzzer.go:460-478)
+    → `update_batch`: (B, W) uint32 bitmap & ~max_cover[call], any-reduce.
+  - corpus / max-cover merge (ref cover.Union, syz-manager corpus merge)
+    → bitwise-or scan into the per-call matrices.
+  - corpus minimization (ref cover.Minimize greedy set cover,
+    syz-manager/manager.go:504-550) → iterative argmax over
+    population_count inside lax.while_loop.
+  - ChoiceTable sampling (ref prog/prio.go:202-249 one draw at a time)
+    → one batched categorical draw over the priority matrix.
+  - dynamic priorities (ref prog/prio.go:137-154 pairwise corpus loop)
+    → one (N×C)·(C×N) matmul on the MXU.
+
+Layout: coverage is a packed bitmap — PC index p lives in word p>>5 bit
+p&31, uint32 words, shape (ncalls, W) where W = ceil(npcs/32).  The PC
+axis (last dim) is the long axis (64k–1M PCs, SURVEY §5 long-context):
+`shard(mesh)` shards it across devices so elementwise diff/merge stays
+local and only the tiny any-reduce / popcount verdicts cross ICI.
+
+Variable-length KCOV PC lists are fed as fixed-shape (B, K) index
+batches with a validity mask (sparse→dense mapping, SURVEY §7 hard
+parts); out-of-range/masked entries are dropped by scatter mode="drop".
+"""
+
+from __future__ import annotations
+
+import functools
+from dataclasses import dataclass
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+def nwords_for(npcs: int, align: int = 8) -> int:
+    w = (npcs + 31) // 32
+    return (w + align - 1) // align * align
+
+
+# ---------------------------------------------------------------------------
+# Pure jittable kernels (shapes static; engine closes over them).
+
+
+def pack_pcs(pc_idx: jax.Array, valid: jax.Array, npcs: int) -> jax.Array:
+    """(B, K) int32 PC indices + mask → (B, W) uint32 packed bitmaps.
+    Invalid/masked indices are routed out of range and dropped."""
+    B = pc_idx.shape[0]
+    W = nwords_for(npcs)
+    # Route masked AND out-of-range indices past the padded bit width so
+    # mode="drop" really drops them (npcs itself can be a valid padding
+    # bit when npcs % (32*align) != 0).
+    ok = valid & (pc_idx >= 0) & (pc_idx < npcs)
+    idx = jnp.where(ok, pc_idx, W * 32)
+    bits = jnp.zeros((B, W * 32), jnp.bool_)
+    bits = bits.at[jnp.arange(B)[:, None], idx].set(True, mode="drop")
+    lanes = bits.reshape(B, W, 32).astype(jnp.uint32)
+    weights = (jnp.uint32(1) << jnp.arange(32, dtype=jnp.uint32))
+    return (lanes * weights[None, None, :]).sum(axis=-1, dtype=jnp.uint32)
+
+
+def signal_diff(bitmaps: jax.Array, base: jax.Array,
+                call_ids: jax.Array) -> tuple[jax.Array, jax.Array]:
+    """new-signal mask per exec: bitmaps & ~base[call].  Returns
+    ((B, W) new-bit bitmaps, (B,) has-new verdicts)."""
+    prev = base[call_ids]                      # (B, W) gather
+    new = jnp.bitwise_and(bitmaps, jnp.bitwise_not(prev))
+    return new, jnp.any(new != 0, axis=-1)
+
+
+def scatter_or(base: jax.Array, call_ids: jax.Array,
+               bitmaps: jax.Array) -> jax.Array:
+    """base[call_ids[i]] |= bitmaps[i] for all i, duplicate-safe.
+    Sequential scan: B tiny dynamic-slice ORs — compiles to a fused loop,
+    the heavy (B, W) work stays in the vectorized ops around it."""
+
+    def body(i, acc):
+        cid = call_ids[i]
+        return acc.at[cid].set(jnp.bitwise_or(acc[cid], bitmaps[i]))
+
+    return jax.lax.fori_loop(0, call_ids.shape[0], body, base)
+
+
+def popcount_rows(mat: jax.Array) -> jax.Array:
+    return jax.lax.population_count(mat).sum(axis=-1, dtype=jnp.int32)
+
+
+def minimize_cover(corpus: jax.Array, active: jax.Array) -> jax.Array:
+    """Greedy set cover over corpus rows (C, W); returns (C,) keep mask.
+    Iterative argmax-of-gain inside a while_loop (ref cover.Minimize)."""
+    C, W = corpus.shape
+
+    def gains(covered):
+        fresh = jnp.bitwise_and(corpus, jnp.bitwise_not(covered)[None, :])
+        return jnp.where(active, popcount_rows(fresh), 0)
+
+    def cond(state):
+        covered, keep = state
+        return jnp.any(gains(covered) > 0)
+
+    def body(state):
+        covered, keep = state
+        g = gains(covered)
+        best = jnp.argmax(g)
+        covered = jnp.bitwise_or(covered, corpus[best])
+        return covered, keep.at[best].set(True)
+
+    covered0 = jnp.zeros((W,), jnp.uint32)
+    keep0 = jnp.zeros((C,), jnp.bool_)
+    _, keep = jax.lax.while_loop(cond, body, (covered0, keep0))
+    return keep
+
+
+def sample_calls(key: jax.Array, probs: jax.Array, prev: jax.Array,
+                 enabled: jax.Array) -> jax.Array:
+    """Batched ChoiceTable draw: (B,) prev call ids (-1 = no context) →
+    (B,) next call ids ~ probs[prev] restricted to enabled calls."""
+    rows = jnp.where(prev[:, None] >= 0,
+                     probs[jnp.clip(prev, 0, probs.shape[0] - 1)],
+                     jnp.ones((1, probs.shape[0]), probs.dtype))
+    logits = jnp.where(enabled[None, :], jnp.log(rows + 1e-9), -jnp.inf)
+    return jax.random.categorical(key, logits, axis=-1)
+
+
+def dynamic_prios(call_matrix: jax.Array) -> jax.Array:
+    """(C, N) multi-hot corpus occurrence → (N, N) dampened co-occurrence.
+    One MXU matmul replaces the reference's pairwise Python/Go loops."""
+    x = call_matrix.astype(jnp.bfloat16)
+    co = jnp.matmul(x.T, x, preferred_element_type=jnp.float32)
+    co = co * (1.0 - jnp.eye(co.shape[0], dtype=jnp.float32))
+    return jnp.sqrt(co)
+
+
+def normalize_prios(prios: jax.Array) -> jax.Array:
+    """Row-normalize to [0.1, 1] (ref prio.go:158-192)."""
+    mx = prios.max(axis=1, keepdims=True)
+    return jnp.where(mx > 0, 0.1 + 0.9 * prios / jnp.maximum(mx, 1e-9), 1.0)
+
+
+def fuzz_step(max_cover: jax.Array, prios: jax.Array, enabled: jax.Array,
+              key: jax.Array, call_ids: jax.Array, pc_idx: jax.Array,
+              valid: jax.Array, npcs: int):
+    """The fused per-batch device step — the framework's 'forward pass':
+    B execs' raw KCOV indices in → per-exec new-signal verdicts, merged
+    max cover, and the next batch of ChoiceTable decisions out.  One jit
+    call covers what the reference does per-exec in cover.Difference +
+    cover.Union + prio.Choose (fuzzer.go:460-478, prio.go:230-249)."""
+    bitmaps = pack_pcs(pc_idx, valid, npcs)
+    new, has_new = signal_diff(bitmaps, max_cover, call_ids)
+    merged = scatter_or(max_cover, call_ids, bitmaps)
+    next_calls = sample_calls(key, prios, call_ids, enabled)
+    return merged, new, has_new, next_calls
+
+
+def random_words(key: jax.Array, n: int) -> np.ndarray:
+    """One device call → n uint64 words for prog.rand.Rand.refill."""
+    bits = jax.random.bits(key, (2, n), dtype=jnp.uint32)
+    hi, lo = np.asarray(bits[0], np.uint64), np.asarray(bits[1], np.uint64)
+    return (hi << np.uint64(32)) | lo
+
+
+# ---------------------------------------------------------------------------
+# The stateful engine: device arrays + jitted steps.
+
+
+@dataclass
+class UpdateResult:
+    has_new: np.ndarray     # (B,) bool — new signal vs max cover
+    new_bits: jax.Array     # (B, W) device-resident diff bitmaps
+
+
+class CoverageEngine:
+    """Device-resident fuzzing state (SURVEY §7 architecture stance).
+
+    Holds per-call max-cover / corpus-cover / flakes bitmaps, the corpus
+    signal matrix, and the priority/choice state.  All updates are jitted
+    fixed-shape steps; multi-chip sharding over the PC axis via shard().
+    """
+
+    def __init__(self, npcs: int, ncalls: int, corpus_cap: int = 4096,
+                 batch: int = 64, max_pcs_per_exec: int = 512,
+                 mesh: "Mesh | None" = None, seed: int = 0):
+        self.npcs = npcs
+        self.ncalls = ncalls
+        self.W = nwords_for(npcs)
+        self.cap = corpus_cap
+        self.batch = batch
+        self.K = max_pcs_per_exec
+        self.mesh = mesh
+        self.key = jax.random.PRNGKey(seed)
+
+        shape_cover = (ncalls, self.W)
+        self.max_cover = jnp.zeros(shape_cover, jnp.uint32)
+        self.corpus_cover = jnp.zeros(shape_cover, jnp.uint32)
+        self.flakes = jnp.zeros(shape_cover, jnp.uint32)
+        self.corpus_mat = jnp.zeros((corpus_cap, self.W), jnp.uint32)
+        self.corpus_call = jnp.zeros((corpus_cap,), jnp.int32)
+        self.corpus_len = 0
+        self.prios = jnp.full((ncalls, ncalls), 1.0, jnp.float32)
+        self.enabled = jnp.ones((ncalls,), jnp.bool_)
+
+        if mesh is not None:
+            self.shard(mesh)
+        self._build()
+
+    # -- sharding ------------------------------------------------------------
+
+    def shard(self, mesh: Mesh) -> None:
+        """Shard the PC (word) axis across `mesh`'s 'pc' axis; call-indexed
+        small state is replicated.  Elementwise diff/merge then runs fully
+        local per chip; cross-chip traffic is the any()/popcount verdicts
+        (psum over ICI), per SURVEY §5's long-axis plan."""
+        self.mesh = mesh
+        row = NamedSharding(mesh, P(None, "pc"))
+        rep = NamedSharding(mesh, P())
+        self.max_cover = jax.device_put(self.max_cover, row)
+        self.corpus_cover = jax.device_put(self.corpus_cover, row)
+        self.flakes = jax.device_put(self.flakes, row)
+        self.corpus_mat = jax.device_put(self.corpus_mat, row)
+        self.corpus_call = jax.device_put(self.corpus_call, rep)
+        self.prios = jax.device_put(self.prios, rep)
+        self.enabled = jax.device_put(self.enabled, rep)
+        self._build()
+
+    # -- jit closures ----------------------------------------------------
+
+    def _build(self) -> None:
+        npcs = self.npcs
+
+        @functools.partial(jax.jit, donate_argnums=(0,))
+        def _update(max_cover, call_ids, pc_idx, valid):
+            bitmaps = pack_pcs(pc_idx, valid, npcs)
+            new, has_new = signal_diff(bitmaps, max_cover, call_ids)
+            merged = scatter_or(max_cover, call_ids, bitmaps)
+            return merged, new, has_new, bitmaps
+
+        @functools.partial(jax.jit, donate_argnums=(0,))
+        def _or_rows(base, call_ids, bitmaps):
+            return scatter_or(base, call_ids, bitmaps)
+
+        @jax.jit
+        def _diff_vs(base, call_ids, pc_idx, valid, flakes):
+            bitmaps = pack_pcs(pc_idx, valid, npcs)
+            prev = base[call_ids]
+            fl = flakes[call_ids]
+            new = jnp.bitwise_and(bitmaps,
+                                  jnp.bitwise_not(jnp.bitwise_or(prev, fl)))
+            return new, jnp.any(new != 0, axis=-1), bitmaps
+
+        @functools.partial(jax.jit, donate_argnums=(0,))
+        def _admit(corpus_mat, bitmaps, admit_mask, start):
+            # append admitted rows at positions start.. ; start is traced
+            # (it changes every admission — static would recompile each time)
+            idx = jnp.cumsum(admit_mask.astype(jnp.int32)) - 1 + start
+            idx = jnp.where(admit_mask, idx, corpus_mat.shape[0])  # drop
+            return corpus_mat.at[idx].set(bitmaps, mode="drop")
+
+        @jax.jit
+        def _minimize(corpus_mat, active):
+            return minimize_cover(corpus_mat, active)
+
+        @jax.jit
+        def _sample(key, probs, prev, enabled):
+            return sample_calls(key, probs, prev, enabled)
+
+        @jax.jit
+        def _prio_update(static_prios, call_matrix):
+            dyn = normalize_prios(dynamic_prios(call_matrix))
+            return normalize_prios(static_prios * dyn)
+
+        self._update_fn = _update
+        self._or_rows_fn = _or_rows
+        self._diff_vs_fn = _diff_vs
+        self._admit_fn = _admit
+        self._minimize_fn = _minimize
+        self._sample_fn = _sample
+        self._prio_update_fn = _prio_update
+
+    # -- public ops ------------------------------------------------------
+
+    def _fit(self, call_ids, pc_idx, valid):
+        call_ids = jnp.asarray(call_ids, jnp.int32)
+        pc_idx = jnp.asarray(pc_idx, jnp.int32)
+        valid = jnp.asarray(valid, jnp.bool_)
+        return call_ids, pc_idx, valid
+
+    def update_batch(self, call_ids, pc_idx, valid) -> UpdateResult:
+        """The hot step: B execs' coverage in, per-exec new-signal verdicts
+        out; max-cover merged in place (single fused jit call)."""
+        call_ids, pc_idx, valid = self._fit(call_ids, pc_idx, valid)
+        self.max_cover, new, has_new, _ = self._update_fn(
+            self.max_cover, call_ids, pc_idx, valid)
+        return UpdateResult(has_new=np.asarray(has_new), new_bits=new)
+
+    def triage_diff(self, call_ids, pc_idx, valid):
+        """Diff vs corpus cover minus flakes (ref triageInput
+        fuzzer.go:384-386); no state mutation."""
+        call_ids, pc_idx, valid = self._fit(call_ids, pc_idx, valid)
+        new, has_new, bitmaps = self._diff_vs_fn(
+            self.corpus_cover, call_ids, pc_idx, valid, self.flakes)
+        return np.asarray(has_new), new, bitmaps
+
+    def add_flakes(self, call_ids, bitmaps) -> None:
+        call_ids = jnp.asarray(call_ids, jnp.int32)
+        self.flakes = self._or_rows_fn(self.flakes, call_ids, bitmaps)
+
+    def merge_corpus(self, call_ids, bitmaps) -> "np.ndarray | None":
+        """Admit execs into corpus cover + the corpus signal matrix.
+        Returns indices assigned (None if corpus is full — nothing is
+        merged then, so the coverage stays re-discoverable later)."""
+        n = int(bitmaps.shape[0])
+        if self.corpus_len + n > self.cap:
+            return None
+        call_ids = jnp.asarray(call_ids, jnp.int32)
+        self.corpus_cover = self._or_rows_fn(self.corpus_cover, call_ids, bitmaps)
+        mask = jnp.ones((n,), jnp.bool_)
+        self.corpus_mat = self._admit_fn(self.corpus_mat, bitmaps, mask,
+                                         jnp.int32(self.corpus_len))
+        idx = np.arange(self.corpus_len, self.corpus_len + n)
+        self.corpus_call = self.corpus_call.at[idx].set(call_ids)
+        self.corpus_len += n
+        return idx
+
+    def minimize_corpus(self) -> np.ndarray:
+        """(cap,) keep mask over the admitted corpus rows."""
+        active = np.zeros((self.cap,), bool)
+        active[: self.corpus_len] = True
+        keep = self._minimize_fn(self.corpus_mat, jnp.asarray(active))
+        return np.asarray(keep)
+
+    def set_priorities(self, static_prios: np.ndarray,
+                       call_matrix: "np.ndarray | None" = None) -> None:
+        sp = jnp.asarray(static_prios, jnp.float32)
+        if call_matrix is not None:
+            self.prios = self._prio_update_fn(sp, jnp.asarray(call_matrix))
+        else:
+            self.prios = sp
+
+    def set_enabled(self, enabled_ids) -> None:
+        m = np.zeros((self.ncalls,), bool)
+        m[np.asarray(list(enabled_ids), int)] = True
+        self.enabled = jnp.asarray(m)
+
+    def sample_next_calls(self, prev_call_ids) -> np.ndarray:
+        """One device call → a whole batch of ChoiceTable decisions."""
+        self.key, sub = jax.random.split(self.key)
+        prev = jnp.asarray(prev_call_ids, jnp.int32)
+        return np.asarray(self._sample_fn(sub, self.prios, prev, self.enabled))
+
+    def random_words(self, n: int) -> np.ndarray:
+        self.key, sub = jax.random.split(self.key)
+        return random_words(sub, n)
+
+    # -- introspection ---------------------------------------------------
+
+    def cover_counts(self) -> np.ndarray:
+        """(ncalls,) covered-PC counts (for stats/UI)."""
+        return np.asarray(jax.jit(popcount_rows)(self.corpus_cover))
+
+    def max_cover_pcs(self, call_id: int) -> np.ndarray:
+        """Unpack one call's max-cover bitmap to sorted PC indices."""
+        row = np.asarray(self.max_cover[call_id])
+        bits = np.unpackbits(row.view(np.uint8), bitorder="little")
+        return np.nonzero(bits)[0].astype(np.uint32)

@@ -1,0 +1,67 @@
+"""Sorted-set coverage algebra (host/CPU reference implementation).
+
+Capability parity with reference cover/cover.go:11-131: a cover is a
+sorted unique array of PC identifiers; Canonicalize, Difference,
+SymmetricDifference, Union, Intersection are merge-based set ops, and
+Minimize is the greedy set cover used for corpus minimization.
+
+This numpy version is (a) the semantic reference the device engine
+(syzkaller_tpu/cover/engine.py) is cross-checked against in tests, and
+(b) the CPU baseline that bench.py compares device throughput to
+(BASELINE.md: "CPU cover.Merge baseline").
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+Cover = np.ndarray  # sorted unique uint32 PCs
+
+
+def canonicalize(pcs) -> Cover:
+    return np.unique(np.asarray(pcs, dtype=np.uint32))
+
+
+def difference(a: Cover, b: Cover) -> Cover:
+    return np.setdiff1d(a, b, assume_unique=True)
+
+
+def symmetric_difference(a: Cover, b: Cover) -> Cover:
+    return np.setxor1d(a, b, assume_unique=True)
+
+
+def union(a: Cover, b: Cover) -> Cover:
+    return np.union1d(a, b)
+
+
+def intersection(a: Cover, b: Cover) -> Cover:
+    return np.intersect1d(a, b, assume_unique=True)
+
+
+def minimize(covers: "list[Cover]") -> list[int]:
+    """Greedy set cover: indices of a subset of `covers` that together
+    cover the union (ref cover/cover.go:105-131).  Largest-first greedy:
+    repeatedly take the cover contributing the most uncovered PCs."""
+    if not covers:
+        return []
+    total = canonicalize(np.concatenate([c for c in covers]) if covers else [])
+    covered = np.zeros(0, dtype=np.uint32)
+    chosen: list[int] = []
+    remaining = set(range(len(covers)))
+    while len(covered) < len(total) and remaining:
+        best, best_gain = -1, 0
+        for i in remaining:
+            gain = len(difference(covers[i], covered))
+            if gain > best_gain:
+                best, best_gain = i, gain
+        if best < 0:
+            break
+        chosen.append(best)
+        remaining.discard(best)
+        covered = union(covered, covers[best])
+    return sorted(chosen)
+
+
+def restore_pc(pc32: int, base: int = 0xFFFFFFFF00000000) -> int:
+    """32→64-bit PC widening (ref cover/cover.go:23)."""
+    return base | pc32

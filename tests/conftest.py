@@ -7,7 +7,12 @@ sharded code runs on 8 virtual devices.  Must be set before jax import.
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# Force CPU for unit tests even when a real TPU is attached (the env sets
+# JAX_PLATFORMS=axon under the tunnel): hermetic, fast compiles, and the
+# 8-virtual-device flag below only applies to the host platform.  The
+# axon sitecustomize registers its backend at interpreter start, so the
+# env var alone is not enough — also pin the config before first use.
+os.environ["JAX_PLATFORMS"] = "cpu"
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
@@ -16,6 +21,13 @@ if "xla_force_host_platform_device_count" not in flags:
 
 import numpy as np
 import pytest
+
+try:
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+except Exception:
+    pass
 
 
 @pytest.fixture
