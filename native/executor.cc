@@ -1,0 +1,807 @@
+// syz-executor: the in-VM native test harness (fork server + bytecode
+// interpreter + coverage reader).
+//
+// Capability parity with the reference executor (executor/executor.cc +
+// executor/common.h): shared-memory fork server with 1-byte pipe
+// handshake, uint64 copyin/call/copyout bytecode interpreter, a 16-thread
+// pool with blocked-call mitigation, collide mode for race provocation,
+// per-thread KCOV readout with sort-dedup, sandboxes, and the magic
+// exit-status taxonomy (67 = executor failure, 68 = kernel bug detected,
+// 69 = retryable). The bytecode format is defined in
+// syzkaller_tpu/prog/encodingexec.py and must match word for word.
+//
+// Differences from the reference: the data window is mapped up front by
+// the worker (programs still issue their own mmap calls over it); when
+// KCOV is unavailable and FLAG_FAKE_COVER is set, deterministic
+// synthetic coverage derived from (nr, args, errno) provides signal so
+// the full pipeline runs on machines without a KCOV kernel.
+//
+// Protocol (set up by syzkaller_tpu/ipc/env.py):
+//   fd 3: shm-in  (2MB):  u64 flags, u64 pid, u64 prog_len, bytecode
+//   fd 4: shm-out (16MB): u32 ncompleted, then per-call records
+//         record: u32 call_index, u32 reserved, i32 errno, u32 cover_n,
+//                 u32 pcs[cover_n]
+//   fd 5: request pipe (read 1 byte per execution request)
+//   fd 6: reply pipe  (write 1 status byte per completed request)
+
+#include <errno.h>
+#include <fcntl.h>
+#include <grp.h>
+#include <pthread.h>
+#include <sched.h>
+#include <setjmp.h>
+#include <signal.h>
+#include <stdarg.h>
+#include <stdint.h>
+#include <stdio.h>
+#include <stdlib.h>
+#include <string.h>
+#include <sys/ioctl.h>
+#include <sys/mman.h>
+#include <sys/prctl.h>
+#include <sys/stat.h>
+#include <sys/syscall.h>
+#include <sys/time.h>
+#include <sys/types.h>
+#include <sys/wait.h>
+#include <time.h>
+#include <unistd.h>
+
+// Default fd numbers; overridable via argv (env.py passes the real ones:
+// python's subprocess closes dup2'd fds that aren't in pass_fds).
+static int kInFd = 3;
+static int kOutFd = 4;
+static int kReqFd = 5;
+static int kRepFd = 6;
+
+const size_t kInSize = 2 << 20;
+const size_t kOutSize = 16 << 20;
+const uintptr_t kDataOffset = 512 << 20;
+const size_t kDataSize = 16 << 20;
+
+const uint64_t instr_eof = ~(uint64_t)0;
+const uint64_t instr_copyin = ~(uint64_t)1;
+const uint64_t instr_copyout = ~(uint64_t)2;
+const uint64_t arg_const = 0;
+const uint64_t arg_result = 1;
+const uint64_t arg_data = 2;
+const uint64_t no_result = ~(uint64_t)0;
+
+const uint64_t kPseudoNrBase = 1000000;
+
+// flags word (shm-in[0]); mirrored in syzkaller_tpu/ipc/env.py
+const uint64_t FLAG_DEBUG = 1 << 0;
+const uint64_t FLAG_COVER = 1 << 1;
+const uint64_t FLAG_THREADED = 1 << 2;
+const uint64_t FLAG_COLLIDE = 1 << 3;
+const uint64_t FLAG_DEDUP_COVER = 1 << 4;
+const uint64_t FLAG_SANDBOX_SETUID = 1 << 5;
+const uint64_t FLAG_SANDBOX_NAMESPACE = 1 << 6;
+const uint64_t FLAG_FAKE_COVER = 1 << 7;
+
+// exit statuses (ref common.h:46-48, decoded by ipc/env.py)
+const int kFailStatus = 67;
+const int kErrorStatus = 68;  // reserved: kernel bug detected
+const int kRetryStatus = 69;
+
+const int kMaxThreads = 16;
+const int kMaxCalls = 64;
+const int kMaxCommands = 16 << 10;
+const uint64_t kCoverSize = 64 << 10;
+
+uint64_t flag_debug, flag_cover, flag_threaded, flag_collide, flag_fake_cover;
+uint64_t flag_dedup, flag_sandbox_setuid, flag_sandbox_namespace;
+uint64_t proc_pid;
+
+char* input_data;
+char* output_data;
+uint32_t* output_pos;
+
+void debug(const char* msg, ...)
+{
+	if (!flag_debug)
+		return;
+	va_list args;
+	va_start(args, msg);
+	vfprintf(stderr, msg, args);
+	va_end(args);
+	fflush(stderr);
+}
+
+__attribute__((noreturn)) void fail(const char* msg, ...)
+{
+	int e = errno;
+	va_list args;
+	va_start(args, msg);
+	vfprintf(stderr, msg, args);
+	va_end(args);
+	fprintf(stderr, " (errno %d: %s)\n", e, strerror(e));
+	exit(kFailStatus);
+}
+
+__attribute__((noreturn)) void exitf(const char* msg, ...)
+{
+	int e = errno;
+	va_list args;
+	va_start(args, msg);
+	vfprintf(stderr, msg, args);
+	va_end(args);
+	fprintf(stderr, " (errno %d: %s)\n", e, strerror(e));
+	exit(kRetryStatus);
+}
+
+// ---------------------------------------------------------------------------
+// SEGV containment: copyin/copyout touch fuzzer-controlled addresses that a
+// munmap call in the program may have unmapped (ref common.h NONFAILING).
+
+static __thread sigjmp_buf segv_env;
+static __thread int segv_armed;
+
+static void segv_handler(int sig, siginfo_t* info, void* ctx)
+{
+	if (segv_armed)
+		siglongjmp(segv_env, 1);
+	_exit(kFailStatus);
+}
+
+void install_segv_handler()
+{
+	struct sigaction sa;
+	memset(&sa, 0, sizeof(sa));
+	sa.sa_sigaction = segv_handler;
+	sa.sa_flags = SA_SIGINFO | SA_NODEFER;
+	sigaction(SIGSEGV, &sa, NULL);
+	sigaction(SIGBUS, &sa, NULL);
+}
+
+#define NONFAILING(...)                     \
+	do {                                \
+		segv_armed = 1;             \
+		if (!sigsetjmp(segv_env, 1)) { \
+			__VA_ARGS__;        \
+		}                           \
+		segv_armed = 0;             \
+	} while (0)
+
+// ---------------------------------------------------------------------------
+// KCOV (ref executor.cc:525-587); falls back to synthetic coverage.
+
+#define KCOV_INIT_TRACE64 _IOR('c', 1, uint64_t)
+#define KCOV_ENABLE _IO('c', 100)
+#define KCOV_DISABLE _IO('c', 101)
+
+struct CoverState {
+	int fd;
+	uint64_t* data; // data[0] = n, data[1..n] = PCs
+};
+
+static __thread CoverState th_cover;
+
+bool cover_open(CoverState* cov)
+{
+	cov->fd = open("/sys/kernel/debug/kcov", O_RDWR);
+	if (cov->fd == -1)
+		return false;
+	if (ioctl(cov->fd, KCOV_INIT_TRACE64, kCoverSize)) {
+		close(cov->fd);
+		cov->fd = -1;
+		return false;
+	}
+	cov->data = (uint64_t*)mmap(NULL, kCoverSize * 8, PROT_READ | PROT_WRITE,
+				    MAP_SHARED, cov->fd, 0);
+	if (cov->data == MAP_FAILED) {
+		close(cov->fd);
+		cov->fd = -1;
+		return false;
+	}
+	if (ioctl(cov->fd, KCOV_ENABLE, 0)) {
+		munmap(cov->data, kCoverSize * 8);
+		close(cov->fd);
+		cov->fd = -1;
+		return false;
+	}
+	return true;
+}
+
+void cover_reset(CoverState* cov)
+{
+	if (cov->fd >= 0)
+		__atomic_store_n(&cov->data[0], 0, __ATOMIC_RELAXED);
+}
+
+// splitmix64: deterministic synthetic "paths" when no KCOV is available.
+static uint64_t mix64(uint64_t x)
+{
+	x += 0x9e3779b97f4a7c15ULL;
+	x = (x ^ (x >> 30)) * 0xbf58476d1ce4e5b9ULL;
+	x = (x ^ (x >> 27)) * 0x94d049bb133111ebULL;
+	return x ^ (x >> 31);
+}
+
+// ---------------------------------------------------------------------------
+// Pseudo syscalls (nr >= kPseudoNrBase). The fixture syz_probe* family is a
+// no-op (ref sys/test.txt semantics: the descriptions are the mock,
+// host/host.go:64-65). Real syz_* helpers are dispatched by nr order of
+// first appearance per call_name — the Python compiler assigns them
+// deterministically and env.py passes a name table when needed.
+
+static long execute_pseudo(uint64_t nr, uint64_t a0, uint64_t a1, uint64_t a2,
+			   uint64_t a3, uint64_t a4, uint64_t a5)
+{
+	(void)a3;
+	(void)a4;
+	(void)a5;
+	// Future: syz_open_dev / syz_open_pts / syz_emit_ethernet etc. keyed
+	// by a generated table. Unknown pseudo-calls are no-ops.
+	return 0;
+}
+
+static long execute_syscall(uint64_t nr, uint64_t a0, uint64_t a1, uint64_t a2,
+			    uint64_t a3, uint64_t a4, uint64_t a5)
+{
+	if (nr >= kPseudoNrBase)
+		return execute_pseudo(nr, a0, a1, a2, a3, a4, a5);
+	return syscall(nr, a0, a1, a2, a3, a4, a5);
+}
+
+// ---------------------------------------------------------------------------
+// Program representation after decode.
+
+struct Call {
+	uint32_t index;
+	uint64_t nr;
+	uint64_t result_idx;
+	uint64_t nargs;
+	uint64_t args[6];
+	// arg refs: for result args we must resolve at execution time
+	uint64_t arg_kind[6]; // arg_const or arg_result
+	uint64_t arg_ref[6];  // result index
+	uint64_t arg_div[6];
+	uint64_t arg_add[6];
+};
+
+struct Copyin {
+	int before_call; // execute before this call index
+	uint64_t addr;
+	uint64_t kind; // const/data/result
+	uint64_t size;
+	uint64_t value;   // const
+	uint64_t ref, divi, addi; // result
+	const char* data; // data
+};
+
+struct Copyout {
+	int after_call;
+	uint64_t result_idx;
+	uint64_t addr;
+	uint64_t size;
+};
+
+struct Prog {
+	Call calls[kMaxCalls];
+	int ncalls;
+	Copyin copyins[kMaxCommands];
+	int ncopyins;
+	Copyout copyouts[kMaxCommands];
+	int ncopyouts;
+};
+
+static uint64_t results[kMaxCommands];
+static bool results_ready[kMaxCommands];
+
+// ---------------------------------------------------------------------------
+// Thread pool (ref executor.cc:392-498). Worker threads execute one call at
+// a time; the main thread hands calls out round-robin and waits with a
+// short timeout so a blocked call doesn't stall the whole program.
+
+struct Thread {
+	pthread_t th;
+	bool created;
+	pthread_mutex_t mu;
+	pthread_cond_t cv_ready;
+	pthread_cond_t cv_done;
+	bool has_work;
+	bool done;
+	Call* call;
+	Prog* prog;
+	long retval;
+	int err;
+	uint32_t cover_n;
+	uint32_t cover[kCoverSize];
+};
+
+static Thread threads[kMaxThreads];
+static pthread_mutex_t output_mu = PTHREAD_MUTEX_INITIALIZER;
+
+static void write_output(Call* c, long retval, int err, uint32_t* cover,
+			 uint32_t n)
+{
+	pthread_mutex_lock(&output_mu);
+	uint32_t* out = output_pos;
+	char* limit = output_data + kOutSize;
+	if ((char*)(out + 5 + n) <= limit) {
+		out[0] = c->index;
+		out[1] = 0;
+		out[2] = (uint32_t)err;
+		out[3] = n;
+		memcpy(out + 4, cover, n * 4);
+		output_pos = out + 4 + n;
+		uint32_t* count = (uint32_t*)output_data;
+		__atomic_fetch_add(count, 1, __ATOMIC_SEQ_CST);
+	}
+	pthread_mutex_unlock(&output_mu);
+	if (c->result_idx != no_result) {
+		results[c->result_idx] = (uint64_t)retval;
+		results_ready[c->result_idx] = true;
+	}
+}
+
+static uint64_t resolve_arg(uint64_t kind, uint64_t val, uint64_t ref,
+			    uint64_t divi, uint64_t addi)
+{
+	if (kind == arg_const)
+		return val;
+	uint64_t v = results_ready[ref] ? results[ref] : (uint64_t)-1;
+	if (divi)
+		v /= divi;
+	v += addi;
+	return v;
+}
+
+static int dedup_sort(uint32_t* cover, uint32_t n)
+{
+	qsort(cover, n, 4, [](const void* a, const void* b) {
+		uint32_t x = *(const uint32_t*)a, y = *(const uint32_t*)b;
+		return x < y ? -1 : x > y ? 1 : 0;
+	});
+	uint32_t w = 0;
+	for (uint32_t i = 0; i < n; i++)
+		if (i == 0 || cover[i] != cover[w - 1])
+			cover[w++] = cover[i];
+	return w;
+}
+
+static void execute_call_on_thread(Thread* t)
+{
+	Call* c = t->call;
+	uint64_t a[6] = {0, 0, 0, 0, 0, 0};
+	for (uint64_t i = 0; i < c->nargs && i < 6; i++)
+		a[i] = resolve_arg(c->arg_kind[i], c->args[i], c->arg_ref[i],
+				   c->arg_div[i], c->arg_add[i]);
+	bool kcov = false;
+	if (flag_cover && !flag_fake_cover) {
+		if (th_cover.fd == 0)
+			kcov = cover_open(&th_cover);
+		else
+			kcov = th_cover.fd > 0;
+		cover_reset(&th_cover);
+	}
+	errno = 0;
+	long res = execute_syscall(c->nr, a[0], a[1], a[2], a[3], a[4], a[5]);
+	int err = res == -1 ? errno : 0;
+	t->retval = res;
+	t->err = err;
+	t->cover_n = 0;
+	if (flag_cover) {
+		if (kcov) {
+			uint64_t n = __atomic_load_n(&th_cover.data[0], __ATOMIC_RELAXED);
+			if (n > kCoverSize - 1)
+				n = kCoverSize - 1;
+			for (uint64_t i = 0; i < n; i++)
+				t->cover[t->cover_n++] = (uint32_t)th_cover.data[i + 1];
+		} else if (flag_fake_cover) {
+			// Deterministic synthetic signal: a "path" per
+			// (nr, coarse args, outcome).
+			uint64_t h = mix64(c->nr * 0x10001 + (uint64_t)err);
+			uint64_t h2 = mix64(h ^ mix64(a[0]) ^ mix64(a[1] * 3) ^
+					    mix64(a[2] * 7));
+			uint32_t n = 8 + (uint32_t)(h % 24);
+			for (uint32_t i = 0; i < n; i++) {
+				uint64_t e = (i < n / 2) ? h : h2;
+				t->cover[t->cover_n++] =
+				    (uint32_t)(mix64(e + i) & 0xffff);
+			}
+		}
+		if (flag_dedup && t->cover_n)
+			t->cover_n = dedup_sort(t->cover, t->cover_n);
+	}
+}
+
+static void* worker_thread(void* arg)
+{
+	Thread* t = (Thread*)arg;
+	install_segv_handler();
+	pthread_mutex_lock(&t->mu);
+	for (;;) {
+		while (!t->has_work)
+			pthread_cond_wait(&t->cv_ready, &t->mu);
+		pthread_mutex_unlock(&t->mu);
+		execute_call_on_thread(t);
+		write_output(t->call, t->retval, t->err, t->cover, t->cover_n);
+		pthread_mutex_lock(&t->mu);
+		t->has_work = false;
+		t->done = true;
+		pthread_cond_signal(&t->cv_done);
+	}
+	return NULL;
+}
+
+static bool thread_wait(Thread* t, int timeout_ms)
+{
+	struct timespec ts;
+	clock_gettime(CLOCK_REALTIME, &ts);
+	ts.tv_nsec += (long)timeout_ms * 1000000;
+	ts.tv_sec += ts.tv_nsec / 1000000000;
+	ts.tv_nsec %= 1000000000;
+	pthread_mutex_lock(&t->mu);
+	while (t->has_work) {
+		if (pthread_cond_timedwait(&t->cv_done, &t->mu, &ts)) {
+			pthread_mutex_unlock(&t->mu);
+			return false;
+		}
+	}
+	pthread_mutex_unlock(&t->mu);
+	return true;
+}
+
+static void thread_submit(Thread* t, Prog* p, Call* c)
+{
+	if (!t->created) {
+		pthread_mutex_init(&t->mu, NULL);
+		pthread_cond_init(&t->cv_ready, NULL);
+		pthread_cond_init(&t->cv_done, NULL);
+		t->created = true;
+		t->has_work = false;
+		if (pthread_create(&t->th, NULL, worker_thread, t))
+			exitf("pthread_create failed");
+	}
+	pthread_mutex_lock(&t->mu);
+	t->call = c;
+	t->prog = p;
+	t->done = false;
+	t->has_work = true;
+	pthread_cond_signal(&t->cv_ready);
+	pthread_mutex_unlock(&t->mu);
+}
+
+// ---------------------------------------------------------------------------
+// Bytecode decode (format: syzkaller_tpu/prog/encodingexec.py).
+
+struct Decoder {
+	uint64_t* pos;
+	uint64_t* end;
+	char* data_area; // heap copy of ARG_DATA payloads
+	size_t data_used;
+};
+
+static uint64_t read_word(Decoder* d)
+{
+	if (d->pos >= d->end)
+		fail("bytecode overrun");
+	return *d->pos++;
+}
+
+static void decode_arg(Decoder* d, uint64_t* kind, uint64_t* size,
+		       uint64_t* value, uint64_t* ref, uint64_t* divi,
+		       uint64_t* addi, const char** data)
+{
+	*kind = read_word(d);
+	*size = read_word(d);
+	*value = *ref = *divi = *addi = 0;
+	*data = NULL;
+	if (*kind == arg_const) {
+		*value = read_word(d);
+	} else if (*kind == arg_result) {
+		*ref = read_word(d);
+		*divi = read_word(d);
+		*addi = read_word(d);
+		if (*ref >= kMaxCommands)
+			fail("result ref out of range");
+	} else if (*kind == arg_data) {
+		uint64_t n = *size;
+		uint64_t words = (n + 7) / 8;
+		if (d->data_used + words * 8 > kInSize)
+			fail("data area overflow");
+		char* dst = d->data_area + d->data_used;
+		for (uint64_t i = 0; i < words; i++) {
+			uint64_t w = read_word(d);
+			memcpy(dst + i * 8, &w, 8);
+		}
+		*data = dst;
+		d->data_used += words * 8;
+	} else {
+		fail("bad arg kind %llu", (unsigned long long)*kind);
+	}
+}
+
+static void decode_prog(uint64_t* words, size_t nwords, Prog* p, char* data_area)
+{
+	Decoder d = {words, words + nwords, data_area, 0};
+	memset(p, 0, sizeof(*p));
+	for (;;) {
+		uint64_t w = read_word(&d);
+		if (w == instr_eof)
+			break;
+		if (w == instr_copyin) {
+			if (p->ncopyins >= kMaxCommands)
+				fail("too many copyins");
+			Copyin* ci = &p->copyins[p->ncopyins++];
+			ci->before_call = p->ncalls;
+			ci->addr = read_word(&d);
+			decode_arg(&d, &ci->kind, &ci->size, &ci->value,
+				   &ci->ref, &ci->divi, &ci->addi, &ci->data);
+			continue;
+		}
+		if (w == instr_copyout) {
+			if (p->ncopyouts >= kMaxCommands)
+				fail("too many copyouts");
+			Copyout* co = &p->copyouts[p->ncopyouts++];
+			co->after_call = p->ncalls - 1;
+			co->result_idx = read_word(&d);
+			co->addr = read_word(&d);
+			co->size = read_word(&d);
+			if (co->result_idx >= kMaxCommands)
+				fail("copyout ref out of range");
+			continue;
+		}
+		// CALL
+		if (p->ncalls >= kMaxCalls)
+			fail("too many calls");
+		Call* c = &p->calls[p->ncalls];
+		c->index = p->ncalls;
+		c->nr = w;
+		c->result_idx = read_word(&d);
+		if (c->result_idx != no_result && c->result_idx >= kMaxCommands)
+			fail("call result out of range");
+		c->nargs = read_word(&d);
+		if (c->nargs > 6)
+			fail("too many args");
+		for (uint64_t i = 0; i < c->nargs; i++) {
+			uint64_t size;
+			const char* data;
+			decode_arg(&d, &c->arg_kind[i], &size, &c->args[i],
+				   &c->arg_ref[i], &c->arg_div[i],
+				   &c->arg_add[i], &data);
+			if (c->arg_kind[i] == arg_data)
+				// top-level data arg: pass pointer to copy
+				c->args[i] = (uint64_t)data,
+				c->arg_kind[i] = arg_const;
+		}
+		p->ncalls++;
+	}
+}
+
+// ---------------------------------------------------------------------------
+// Copy helpers with SEGV containment.
+
+static void do_copyin(Copyin* ci)
+{
+	char* addr = (char*)ci->addr;
+	if (ci->kind == arg_data) {
+		NONFAILING(memcpy(addr, ci->data, ci->size));
+		return;
+	}
+	uint64_t v = resolve_arg(ci->kind, ci->value, ci->ref, ci->divi, ci->addi);
+	switch (ci->size) {
+	case 1:
+		NONFAILING(*(uint8_t*)addr = (uint8_t)v);
+		break;
+	case 2:
+		NONFAILING(*(uint16_t*)addr = (uint16_t)v);
+		break;
+	case 4:
+		NONFAILING(*(uint32_t*)addr = (uint32_t)v);
+		break;
+	case 8:
+		NONFAILING(*(uint64_t*)addr = v);
+		break;
+	default:
+		NONFAILING(memcpy(addr, &v, ci->size < 8 ? ci->size : 8));
+	}
+}
+
+static void do_copyout(Copyout* co)
+{
+	uint64_t v = 0;
+	char* addr = (char*)co->addr;
+	switch (co->size) {
+	case 1:
+		NONFAILING(v = *(uint8_t*)addr);
+		break;
+	case 2:
+		NONFAILING(v = *(uint16_t*)addr);
+		break;
+	case 4:
+		NONFAILING(v = *(uint32_t*)addr);
+		break;
+	default:
+		NONFAILING(v = *(uint64_t*)addr);
+	}
+	results[co->result_idx] = v;
+	results_ready[co->result_idx] = true;
+}
+
+// ---------------------------------------------------------------------------
+// Program execution (ref executor.cc:277-390).
+
+static void execute_one(Prog* p, bool collide)
+{
+	memset(results_ready, 0, sizeof(results_ready));
+	int ici = 0, ico = 0;
+	int next_thread = 0;
+	for (int i = 0; i < p->ncalls; i++) {
+		while (ici < p->ncopyins && p->copyins[ici].before_call <= i)
+			do_copyin(&p->copyins[ici++]);
+		Call* c = &p->calls[i];
+		if (flag_threaded) {
+			Thread* t = &threads[next_thread];
+			next_thread = (next_thread + 1) % kMaxThreads;
+			if (t->created && t->has_work && !thread_wait(t, 1000))
+				continue; // thread stuck; skip its slot
+			thread_submit(t, p, c);
+			// collide mode: issue every 2nd call without waiting
+			// (ref executor.cc:342-345)
+			if (!collide || (i % 2) == 0)
+				thread_wait(t, 45);
+		} else {
+			Thread* t = &threads[0];
+			t->call = c;
+			execute_call_on_thread(t);
+			write_output(c, t->retval, t->err, t->cover, t->cover_n);
+		}
+		while (ico < p->ncopyouts && p->copyouts[ico].after_call <= i) {
+			// Reads are SEGV-contained; if the call is still
+			// blocked the value is whatever memory holds, which
+			// matches the reference's racy-copyout semantics.
+			do_copyout(&p->copyouts[ico]);
+			ico++;
+		}
+	}
+	if (flag_threaded)
+		for (int i = 0; i < kMaxThreads; i++)
+			if (threads[i].created)
+				thread_wait(&threads[i], 100);
+}
+
+// ---------------------------------------------------------------------------
+// Sandboxes (ref common.h:462-585).
+
+static void sandbox_setuid()
+{
+	prctl(PR_SET_PDEATHSIG, SIGKILL);
+	const int nobody = 65534;
+	if (setgroups(0, NULL))
+		debug("setgroups failed\n");
+	if (setresgid(nobody, nobody, nobody))
+		debug("setresgid failed\n");
+	if (setresuid(nobody, nobody, nobody))
+		debug("setresuid failed\n");
+}
+
+static void sandbox_namespace()
+{
+	// best-effort: user+mount+net namespaces; fall through when the
+	// kernel/container denies them (ref common.h namespace sandbox with
+	// pivot_root; full rootfs isolation needs the VM environment).
+	if (unshare(CLONE_NEWUSER | CLONE_NEWNS | CLONE_NEWNET))
+		debug("unshare failed: %d\n", errno);
+}
+
+// ---------------------------------------------------------------------------
+// Worker process: one program execution in a fresh process + cwd
+// (ref executor.cc:204-275 per-iteration loop).
+
+static int run_worker(Prog* p)
+{
+	int pid = fork();
+	if (pid < 0)
+		exitf("fork failed");
+	if (pid == 0) {
+		prctl(PR_SET_PDEATHSIG, SIGKILL);
+		setpgid(0, 0);
+		char tmpdir[64];
+		snprintf(tmpdir, sizeof(tmpdir), "./syzw%d", (int)getpid());
+		if (mkdir(tmpdir, 0777) == 0)
+			if (chdir(tmpdir))
+				debug("chdir failed\n");
+		// map the data window (programs overlay their own mmaps)
+		void* want = (void*)kDataOffset;
+		void* got = mmap(want, kDataSize, PROT_READ | PROT_WRITE,
+				 MAP_PRIVATE | MAP_ANONYMOUS | MAP_FIXED, -1, 0);
+		if (got != want)
+			exitf("data window mmap failed");
+		if (flag_sandbox_setuid)
+			sandbox_setuid();
+		else if (flag_sandbox_namespace)
+			sandbox_namespace();
+		install_segv_handler();
+		execute_one(p, false);
+		if (flag_collide)
+			execute_one(p, true);
+		_exit(0);
+	}
+	// supervise: 5s hang kill (ref executor.cc:252-264)
+	uint64_t start_ms = 0;
+	struct timespec ts;
+	clock_gettime(CLOCK_MONOTONIC, &ts);
+	start_ms = ts.tv_sec * 1000ull + ts.tv_nsec / 1000000;
+	for (;;) {
+		int status = 0;
+		int res = waitpid(pid, &status, WNOHANG);
+		if (res == pid)
+			return WIFEXITED(status) ? WEXITSTATUS(status) : kFailStatus;
+		usleep(1000);
+		clock_gettime(CLOCK_MONOTONIC, &ts);
+		uint64_t now = ts.tv_sec * 1000ull + ts.tv_nsec / 1000000;
+		if (now - start_ms > 5000) {
+			kill(-pid, SIGKILL);
+			kill(pid, SIGKILL);
+			while (waitpid(pid, &status, 0) != pid)
+				;
+			return 0; // hang is not a protocol failure
+		}
+	}
+}
+
+// ---------------------------------------------------------------------------
+
+int main(int argc, char** argv)
+{
+	if (argc > 1 && strcmp(argv[1], "version") == 0) {
+		printf("syzkaller-tpu executor 1\n");
+		return 0;
+	}
+	if (argc >= 5) {
+		kInFd = atoi(argv[1]);
+		kOutFd = atoi(argv[2]);
+		kReqFd = atoi(argv[3]);
+		kRepFd = atoi(argv[4]);
+	}
+	input_data = (char*)mmap(NULL, kInSize, PROT_READ, MAP_SHARED, kInFd, 0);
+	if (input_data == MAP_FAILED)
+		fail("mmap of input shm failed");
+	output_data = (char*)mmap(NULL, kOutSize, PROT_READ | PROT_WRITE,
+				  MAP_SHARED, kOutFd, 0);
+	if (output_data == MAP_FAILED)
+		fail("mmap of output shm failed");
+
+	static Prog prog;
+	static char data_copy[kInSize];
+
+	for (;;) {
+		char req = 0;
+		int n = read(kReqFd, &req, 1);
+		if (n == 0)
+			return 0; // parent closed: clean shutdown
+		if (n != 1) {
+			if (errno == EINTR)
+				continue;
+			fail("request pipe read failed");
+		}
+		uint64_t* words = (uint64_t*)input_data;
+		uint64_t flags = words[0];
+		proc_pid = words[1];
+		uint64_t prog_len = words[2];
+		flag_debug = flags & FLAG_DEBUG;
+		flag_cover = flags & FLAG_COVER;
+		flag_threaded = flags & FLAG_THREADED;
+		flag_collide = flags & FLAG_COLLIDE;
+		flag_dedup = flags & FLAG_DEDUP_COVER;
+		flag_sandbox_setuid = flags & FLAG_SANDBOX_SETUID;
+		flag_sandbox_namespace = flags & FLAG_SANDBOX_NAMESPACE;
+		flag_fake_cover = flags & FLAG_FAKE_COVER;
+
+		if (prog_len * 8 > kInSize - 24)
+			fail("program too large");
+		decode_prog(words + 3, prog_len, &prog, data_copy);
+
+		// reset output
+		memset(output_data, 0, 64);
+		output_pos = (uint32_t*)(output_data + 8);
+
+		int status = run_worker(&prog);
+		char rep = (char)status;
+		if (write(kRepFd, &rep, 1) != 1)
+			fail("reply pipe write failed");
+	}
+}

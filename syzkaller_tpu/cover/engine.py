@@ -29,6 +29,7 @@ parts); out-of-range/masked entries are dropped by scatter mode="drop".
 from __future__ import annotations
 
 import functools
+import threading
 from dataclasses import dataclass
 
 import jax
@@ -156,11 +157,15 @@ def fuzz_step(max_cover: jax.Array, prios: jax.Array, enabled: jax.Array,
     return merged, new, has_new, next_calls
 
 
-def random_words(key: jax.Array, n: int) -> np.ndarray:
-    """One device call → n uint64 words for prog.rand.Rand.refill."""
-    bits = jax.random.bits(key, (2, n), dtype=jnp.uint32)
+def _combine_words(bits) -> np.ndarray:
+    """(2, n) uint32 halves → (n,) uint64 words."""
     hi, lo = np.asarray(bits[0], np.uint64), np.asarray(bits[1], np.uint64)
     return (hi << np.uint64(32)) | lo
+
+
+def random_words(key: jax.Array, n: int) -> np.ndarray:
+    """One device call → n uint64 words for prog.rand.Rand.refill."""
+    return _combine_words(jax.random.bits(key, (2, n), dtype=jnp.uint32))
 
 
 # ---------------------------------------------------------------------------
@@ -171,6 +176,7 @@ def random_words(key: jax.Array, n: int) -> np.ndarray:
 class UpdateResult:
     has_new: np.ndarray     # (B,) bool — new signal vs max cover
     new_bits: jax.Array     # (B, W) device-resident diff bitmaps
+    bitmaps: jax.Array      # (B, W) device-resident full exec bitmaps
 
 
 class CoverageEngine:
@@ -192,13 +198,14 @@ class CoverageEngine:
         self.K = max_pcs_per_exec
         self.mesh = mesh
         self.key = jax.random.PRNGKey(seed)
+        self._key_mu = threading.Lock()
 
         shape_cover = (ncalls, self.W)
         self.max_cover = jnp.zeros(shape_cover, jnp.uint32)
         self.corpus_cover = jnp.zeros(shape_cover, jnp.uint32)
         self.flakes = jnp.zeros(shape_cover, jnp.uint32)
         self.corpus_mat = jnp.zeros((corpus_cap, self.W), jnp.uint32)
-        self.corpus_call = jnp.zeros((corpus_cap,), jnp.int32)
+        self.corpus_call = np.zeros((corpus_cap,), np.int32)  # host-read only
         self.corpus_len = 0
         self.prios = jnp.full((ncalls, ncalls), 1.0, jnp.float32)
         self.enabled = jnp.ones((ncalls,), jnp.bool_)
@@ -221,7 +228,6 @@ class CoverageEngine:
         self.corpus_cover = jax.device_put(self.corpus_cover, row)
         self.flakes = jax.device_put(self.flakes, row)
         self.corpus_mat = jax.device_put(self.corpus_mat, row)
-        self.corpus_call = jax.device_put(self.corpus_call, rep)
         self.prios = jax.device_put(self.prios, rep)
         self.enabled = jax.device_put(self.enabled, rep)
         self._build()
@@ -259,6 +265,19 @@ class CoverageEngine:
             idx = jnp.where(admit_mask, idx, corpus_mat.shape[0])  # drop
             return corpus_mat.at[idx].set(bitmaps, mode="drop")
 
+        @functools.partial(jax.jit, donate_argnums=(0, 1))
+        def _admit_selected(corpus_cover, corpus_mat, bitmaps, call_ids,
+                            row_idx, mask, start):
+            """Fused corpus admission for selected exec rows, fixed shape:
+            row_idx/mask select which bitmap rows get admitted."""
+            rows = jnp.where(mask[:, None], bitmaps[row_idx], jnp.uint32(0))
+            sel_ids = call_ids[row_idx]
+            cover = scatter_or(corpus_cover, sel_ids, rows)
+            idx = jnp.cumsum(mask.astype(jnp.int32)) - 1 + start
+            idx = jnp.where(mask, idx, corpus_mat.shape[0])
+            mat = corpus_mat.at[idx].set(rows, mode="drop")
+            return cover, mat
+
         @jax.jit
         def _minimize(corpus_mat, active):
             return minimize_cover(corpus_mat, active)
@@ -272,6 +291,17 @@ class CoverageEngine:
             dyn = normalize_prios(dynamic_prios(call_matrix))
             return normalize_prios(static_prios * dyn)
 
+        @functools.partial(jax.jit, static_argnums=(1,))
+        def _random_bits(key, n):
+            return jax.random.bits(key, (2, n), dtype=jnp.uint32)
+
+        @jax.jit
+        def _popcount(mat):
+            return popcount_rows(mat)
+
+        self._random_bits_fn = _random_bits
+        self._popcount_fn = _popcount
+        self._admit_selected_fn = _admit_selected
         self._update_fn = _update
         self._or_rows_fn = _or_rows
         self._diff_vs_fn = _diff_vs
@@ -290,11 +320,40 @@ class CoverageEngine:
 
     def update_batch(self, call_ids, pc_idx, valid) -> UpdateResult:
         """The hot step: B execs' coverage in, per-exec new-signal verdicts
-        out; max-cover merged in place (single fused jit call)."""
+        out; max-cover merged in place (single fused jit call).
+        Keep the batch shape constant across calls — each new shape costs
+        an XLA compile (pad with valid=False rows instead)."""
         call_ids, pc_idx, valid = self._fit(call_ids, pc_idx, valid)
-        self.max_cover, new, has_new, _ = self._update_fn(
+        self.max_cover, new, has_new, bitmaps = self._update_fn(
             self.max_cover, call_ids, pc_idx, valid)
-        return UpdateResult(has_new=np.asarray(has_new), new_bits=new)
+        return UpdateResult(has_new=np.asarray(has_new), new_bits=new,
+                            bitmaps=bitmaps)
+
+    def admit_rows(self, result: UpdateResult, call_ids,
+                   rows) -> "np.ndarray | None":
+        """Admit selected exec rows of an update_batch result into the
+        corpus (cover + signal matrix) in one fused fixed-shape jit call.
+        Returns assigned corpus indices, or None if the corpus is full."""
+        B = int(result.bitmaps.shape[0])
+        rows = np.asarray(rows, np.int32)
+        n = len(rows)
+        if n == 0:
+            return np.zeros((0,), np.int64)
+        if self.corpus_len + n > self.cap:
+            return None
+        row_idx = np.zeros((B,), np.int32)
+        mask = np.zeros((B,), bool)
+        row_idx[:n] = rows
+        mask[:n] = True
+        call_ids = jnp.asarray(call_ids, jnp.int32)
+        self.corpus_cover, self.corpus_mat = self._admit_selected_fn(
+            self.corpus_cover, self.corpus_mat, result.bitmaps, call_ids,
+            jnp.asarray(row_idx), jnp.asarray(mask),
+            jnp.int32(self.corpus_len))
+        idx = np.arange(self.corpus_len, self.corpus_len + n)
+        self.corpus_call[idx] = np.asarray(call_ids)[rows]
+        self.corpus_len += n
+        return idx
 
     def triage_diff(self, call_ids, pc_idx, valid):
         """Diff vs corpus cover minus flakes (ref triageInput
@@ -321,7 +380,7 @@ class CoverageEngine:
         self.corpus_mat = self._admit_fn(self.corpus_mat, bitmaps, mask,
                                          jnp.int32(self.corpus_len))
         idx = np.arange(self.corpus_len, self.corpus_len + n)
-        self.corpus_call = self.corpus_call.at[idx].set(call_ids)
+        self.corpus_call[idx] = np.asarray(call_ids)
         self.corpus_len += n
         return idx
 
@@ -345,21 +404,27 @@ class CoverageEngine:
         m[np.asarray(list(enabled_ids), int)] = True
         self.enabled = jnp.asarray(m)
 
+    def _next_key(self):
+        # proc threads share the engine: split under a lock or two threads
+        # get identical PRNG streams
+        with self._key_mu:
+            self.key, sub = jax.random.split(self.key)
+        return sub
+
     def sample_next_calls(self, prev_call_ids) -> np.ndarray:
         """One device call → a whole batch of ChoiceTable decisions."""
-        self.key, sub = jax.random.split(self.key)
+        sub = self._next_key()
         prev = jnp.asarray(prev_call_ids, jnp.int32)
         return np.asarray(self._sample_fn(sub, self.prios, prev, self.enabled))
 
     def random_words(self, n: int) -> np.ndarray:
-        self.key, sub = jax.random.split(self.key)
-        return random_words(sub, n)
+        return _combine_words(self._random_bits_fn(self._next_key(), n))
 
     # -- introspection ---------------------------------------------------
 
     def cover_counts(self) -> np.ndarray:
         """(ncalls,) covered-PC counts (for stats/UI)."""
-        return np.asarray(jax.jit(popcount_rows)(self.corpus_cover))
+        return np.asarray(self._popcount_fn(self.corpus_cover))
 
     def max_cover_pcs(self, call_id: int) -> np.ndarray:
         """Unpack one call's max-cover bitmap to sorted PC indices."""

@@ -1,0 +1,51 @@
+"""Device-backed ChoiceTable adapter.
+
+Bridges the per-decision interface the program generator wants
+(choose(rand, prev) — ref prog/prio.go:230) to batched device sampling:
+one jit call draws a whole batch of decisions conditioned on the same
+previous call, cached and handed out one by one. This is the
+"amortize the device round-trip" pattern from SURVEY §7.
+"""
+
+from __future__ import annotations
+
+import threading
+from collections import deque
+
+import numpy as np
+
+
+class DeviceChoiceTable:
+    """Thread-safe: stress/fuzzer proc threads share one instance."""
+
+    def __init__(self, engine, per_row: int = 64):
+        self.engine = engine
+        self.per_row = per_row
+        self._cache: dict[int, deque] = {}
+        self._mu = threading.Lock()
+
+    def _refill_all(self) -> None:
+        """ONE device call draws `per_row` decisions for every possible
+        previous call (plus the no-context row): (ncalls+1)*per_row
+        categorical draws, amortizing tunnel latency over thousands of
+        choose() calls."""
+        n = self.engine.ncalls
+        prev = np.repeat(np.arange(-1, n, dtype=np.int32), self.per_row)
+        draws = self.engine.sample_next_calls(prev)
+        for row in range(-1, n):
+            lo = (row + 1) * self.per_row
+            self._cache[row] = deque(
+                int(x) for x in draws[lo: lo + self.per_row])
+
+    def choose(self, r, prev_call_id: int = -1) -> int:
+        with self._mu:
+            q = self._cache.get(prev_call_id)
+            if not q:
+                self._refill_all()
+                q = self._cache[prev_call_id]
+            return q.popleft()
+
+    def invalidate(self) -> None:
+        """Drop cached draws (call after the priority matrix changes)."""
+        with self._mu:
+            self._cache.clear()

@@ -1,0 +1,56 @@
+"""Sparse→dense PC mapping: raw kernel PCs → bitmap indices.
+
+SURVEY §7 hard parts: KCOV returns variable-length lists of raw PCs; the
+device wants fixed-shape index batches. This map assigns dense indices
+on first sight (vmlinux-derived tables can pre-seed it, the analog of
+syz-manager/cover.go:274-312's objdump scan). Unknown PCs beyond
+capacity fold into a hashed overflow region instead of being dropped, so
+signal is degraded gracefully rather than lost (modules/KASLR case).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+class PcMap:
+    def __init__(self, npcs: int, reserve_overflow: int = 1024):
+        assert npcs > reserve_overflow
+        self.npcs = npcs
+        self.direct_cap = npcs - reserve_overflow
+        self.overflow = reserve_overflow
+        self._map: dict[int, int] = {}
+
+    def __len__(self) -> int:
+        return len(self._map)
+
+    def preseed(self, pcs) -> None:
+        """Pre-assign indices for a known PC universe (vmlinux scan)."""
+        for pc in pcs:
+            self.index_of(int(pc))
+
+    def index_of(self, pc: int) -> int:
+        idx = self._map.get(pc)
+        if idx is None:
+            if len(self._map) < self.direct_cap:
+                idx = len(self._map)
+                self._map[pc] = idx
+            else:
+                # overflow: stable hash into the reserved tail
+                idx = self.direct_cap + (hash(pc) % self.overflow)
+        return idx
+
+    def map_batch(self, covers: "list[np.ndarray]", K: int
+                  ) -> tuple[np.ndarray, np.ndarray]:
+        """List of raw-PC arrays → padded (B, K) index batch + mask.
+        Covers longer than K are truncated (the tail is the rarely-hit
+        part after sort-dedup; reference caps at 64k PCs/call too)."""
+        B = len(covers)
+        idx = np.zeros((B, K), np.int32)
+        valid = np.zeros((B, K), bool)
+        for i, cov in enumerate(covers):
+            n = min(len(cov), K)
+            for j in range(n):
+                idx[i, j] = self.index_of(int(cov[j]))
+            valid[i, :n] = True
+        return idx, valid

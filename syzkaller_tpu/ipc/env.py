@@ -1,0 +1,309 @@
+"""Execution environment: shared memory + pipe protocol to the native
+executor.
+
+Capability parity with reference ipc/ipc.go: Env with 2MB-in/16MB-out
+file-backed shm (:105-137), the flag bitmask (:41-50), 1-byte pipe
+request/reply with timeout kill (:187-293, :501-560), per-call coverage
+parsing from shm-out (:224-292), transparent env teardown/relaunch
+(:206-218), and the magic exit-status taxonomy 67/68/69 (:538-557).
+"""
+
+from __future__ import annotations
+
+import os
+import select
+import signal
+import struct
+import subprocess
+import tempfile
+import time
+from dataclasses import dataclass, field
+
+import numpy as np
+
+from syzkaller_tpu.native import build as native_build
+from syzkaller_tpu.prog import model as M
+from syzkaller_tpu.prog.encodingexec import serialize_for_exec
+from syzkaller_tpu.utils import log
+
+IN_SHM_SIZE = 2 << 20
+OUT_SHM_SIZE = 16 << 20
+
+# flag bits (mirrored in native/executor.cc)
+FLAG_DEBUG = 1 << 0
+FLAG_COVER = 1 << 1
+FLAG_THREADED = 1 << 2
+FLAG_COLLIDE = 1 << 3
+FLAG_DEDUP_COVER = 1 << 4
+FLAG_SANDBOX_SETUID = 1 << 5
+FLAG_SANDBOX_NAMESPACE = 1 << 6
+FLAG_FAKE_COVER = 1 << 7
+
+# executor exit statuses (ref common.h:46-48)
+STATUS_OK = 0
+STATUS_FAIL = 67     # executor logic failure -> hard error
+STATUS_ERROR = 68    # kernel bug detected
+STATUS_RETRY = 69    # transient -> relaunch env
+
+
+class ExecutorFailure(Exception):
+    """The executor itself misbehaved (protocol/logic error, status 67)."""
+
+
+@dataclass
+class CallResult:
+    index: int
+    errno: int
+    cover: np.ndarray  # uint32 PCs, sorted+deduped when FLAG_DEDUP_COVER
+
+
+@dataclass
+class ExecResult:
+    calls: list[CallResult] = field(default_factory=list)
+    failed: bool = False    # executor reported failure
+    hanged: bool = False    # worker killed on timeout
+    restarted: bool = False # env was relaunched
+
+    def per_call(self, ncalls: int) -> "list[CallResult | None]":
+        out: "list[CallResult | None]" = [None] * ncalls
+        for c in self.calls:
+            if 0 <= c.index < ncalls:
+                out[c.index] = c
+        return out
+
+
+class Env:
+    """One executor instance: spawn, feed programs, parse results."""
+
+    def __init__(self, flags: int = FLAG_COVER | FLAG_DEDUP_COVER,
+                 pid: int = 0, executor: "str | None" = None,
+                 workdir: "str | None" = None, timeout: float = 10.0):
+        self.flags = flags
+        self.pid = pid
+        self.timeout = timeout
+        self.executor = executor or native_build.build_executor()
+        self.workdir = workdir or tempfile.mkdtemp(prefix="syz-env-")
+        os.makedirs(self.workdir, exist_ok=True)
+        self._in_file = os.path.join(self.workdir, f"shm-in-{pid}")
+        self._out_file = os.path.join(self.workdir, f"shm-out-{pid}")
+        self._proc: "subprocess.Popen | None" = None
+        self._in_mm = None
+        self._out_mm = None
+        self.stat_execs = 0
+        self.stat_restarts = 0
+        self._open_shm()
+
+    def _open_shm(self) -> None:
+        import mmap
+
+        for path, size in ((self._in_file, IN_SHM_SIZE),
+                           (self._out_file, OUT_SHM_SIZE)):
+            with open(path, "wb") as f:
+                f.truncate(size)
+        self._in_fd = os.open(self._in_file, os.O_RDWR)
+        self._out_fd = os.open(self._out_file, os.O_RDWR)
+        self._in_mm = mmap.mmap(self._in_fd, IN_SHM_SIZE)
+        self._out_mm = mmap.mmap(self._out_fd, OUT_SHM_SIZE)
+
+    def _start(self) -> None:
+        req_r, req_w = os.pipe()
+        rep_r, rep_w = os.pipe()
+        # executor sees: 3=shm-in 4=shm-out 5=req-read 6=rep-write
+        self._proc = self._spawn(req_r, rep_w)
+        os.close(req_r)
+        os.close(rep_w)
+        self._req_w = req_w
+        self._rep_r = rep_r
+
+    def _spawn(self, req_r: int, rep_w: int) -> subprocess.Popen:
+        # fd numbers go via argv: subprocess keeps pass_fds at their
+        # original numbers (dup2-in-preexec would be undone by close_fds).
+        fds = (self._in_fd, self._out_fd, req_r, rep_w)
+        return subprocess.Popen(
+            [self.executor, *map(str, fds)],
+            pass_fds=fds,
+            stdin=subprocess.DEVNULL,
+            stdout=subprocess.DEVNULL,
+            stderr=None if (self.flags & FLAG_DEBUG) else subprocess.DEVNULL,
+            cwd=self.workdir,
+            start_new_session=True,
+        )
+
+    def _close_pipes(self) -> None:
+        for fd in (getattr(self, "_req_w", -1), getattr(self, "_rep_r", -1)):
+            if fd >= 0:
+                try:
+                    os.close(fd)
+                except OSError:
+                    pass
+        self._req_w = self._rep_r = -1
+
+    def _kill(self) -> None:
+        if self._proc is not None:
+            try:
+                os.killpg(self._proc.pid, signal.SIGKILL)
+            except (ProcessLookupError, PermissionError):
+                pass
+            try:
+                self._proc.kill()
+            except ProcessLookupError:
+                pass
+            self._proc.wait()
+            self._proc = None
+        self._close_pipes()
+
+    def close(self) -> None:
+        self._kill()
+        for mm in (self._in_mm, self._out_mm):
+            if mm is not None:
+                mm.close()
+        for fd in (self._in_fd, self._out_fd):
+            try:
+                os.close(fd)
+            except OSError:
+                pass
+
+    # -- execution ---------------------------------------------------------
+
+    def exec(self, p: "M.Prog | bytes") -> ExecResult:
+        """Run one program; relaunches the executor transparently on
+        hang/retryable failure (ref ipc.go:206-218)."""
+        data = p if isinstance(p, bytes) else serialize_for_exec(p, self.pid)
+        res = ExecResult()
+        if self._proc is None or self._proc.poll() is not None:
+            self._kill()
+            self._start()
+            res.restarted = True
+            self.stat_restarts += 1
+
+        header = struct.pack("<QQQ", self.flags, self.pid, len(data) // 8)
+        self._in_mm.seek(0)
+        self._in_mm.write(header + data)
+        self._out_mm.seek(0)
+        self._out_mm.write(b"\x00" * 8)
+
+        try:
+            os.write(self._req_w, b"r")
+        except BrokenPipeError:
+            self._kill()
+            raise ExecutorFailure("executor died before request")
+
+        ready, _, _ = select.select([self._rep_r], [], [], self.timeout)
+        if not ready:
+            # hung executor: kill + relaunch next time
+            self._kill()
+            res.hanged = True
+            self._parse_output(res)
+            return res
+        reply = os.read(self._rep_r, 1)
+        self.stat_execs += 1
+        if len(reply) == 0:
+            # executor exited; classify by status (ref ipc.go:538-557)
+            code = self._proc.wait() if self._proc else -1
+            self._proc = None
+            self._close_pipes()
+            if code == STATUS_FAIL:
+                raise ExecutorFailure("executor failed (status 67)")
+            res.restarted = True
+            self._parse_output(res)
+            return res
+        status = reply[0]
+        if status == STATUS_FAIL:
+            res.failed = True
+        elif status == STATUS_ERROR:
+            # worker saw a kernel-bug indicator
+            res.failed = True
+        elif status == STATUS_RETRY:
+            # transient worker failure: tear the env down so the next
+            # exec relaunches it cleanly
+            self._kill()
+            res.restarted = True
+        self._parse_output(res)
+        return res
+
+    def _parse_output(self, res: ExecResult) -> None:
+        # zero-copy view over the shm: only the consumed region is touched
+        # (a full .read() would memcpy all 16MB per exec)
+        buf = memoryview(self._out_mm)
+        (count,) = struct.unpack_from("<I", buf, 0)
+        pos = 8
+        for _ in range(min(count, 4096)):
+            if pos + 16 > len(buf):
+                break
+            idx, _resv, err, ncov = struct.unpack_from("<IIiI", buf, pos)
+            pos += 16
+            if ncov > (len(buf) - pos) // 4:
+                break
+            cover = np.frombuffer(buf, dtype=np.uint32, count=ncov,
+                                  offset=pos).copy()
+            pos += ncov * 4
+            res.calls.append(CallResult(index=idx, errno=err, cover=cover))
+        buf.release()
+
+
+class Gate:
+    """Bounded concurrency window + epoch callback (ref ipc/gate.go:10-77):
+    at most `size` sections in flight; when the section that closes a
+    window of `size` leaves AND everything before it has left, `callback`
+    runs exclusively — new entries block until it finishes (used for
+    leak-check scans between execution batches)."""
+
+    def __init__(self, size: int, callback=None):
+        import threading
+
+        self.size = size
+        self.callback = callback
+        self._busy = 0
+        self._pos = 0
+        self._running = [False] * size
+        self._in_callback = False
+        self._cv = threading.Condition()
+
+    def enter(self) -> int:
+        with self._cv:
+            while self._busy >= self.size or self._in_callback:
+                self._cv.wait()
+            idx = self._pos
+            self._pos = (self._pos + 1) % self.size
+            self._busy += 1
+            self._running[idx] = True
+            return idx
+
+    def leave(self, idx: int) -> None:
+        run_cb = False
+        with self._cv:
+            self._running[idx] = False
+            self._busy -= 1
+            if (idx == self.size - 1 and self.callback is not None
+                    and not any(self._running)):
+                run_cb = True
+                self._in_callback = True
+        if run_cb:
+            try:
+                self.callback()
+            finally:
+                with self._cv:
+                    self._in_callback = False
+                    self._cv.notify_all()
+
+    def section(self):
+        """Context manager for one gated section (thread-safe — the slot
+        token lives in the manager object, not on the shared Gate)."""
+        gate = self
+
+        class _Section:
+            def __enter__(self_s):
+                self_s.idx = gate.enter()
+                return self_s
+
+            def __exit__(self_s, *exc):
+                gate.leave(self_s.idx)
+                return False
+
+        return _Section()
+
+    def __enter__(self):
+        raise TypeError("use Gate.section(): 'with gate.section(): ...'")
+
+    def __exit__(self, *exc):  # pragma: no cover
+        return False

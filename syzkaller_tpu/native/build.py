@@ -1,0 +1,49 @@
+"""Native component build: compile + cache the C++ executor.
+
+Capability analog of the reference Makefile's executor target
+(Makefile:21-22: gcc -O1 -static executor.cc). Static linking is
+attempted first (the binary gets copied into VMs, ref
+syz-manager/manager.go:354-361) with a dynamic fallback for containers
+without static libc.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import os
+import subprocess
+
+NATIVE_DIR = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__)))), "native")
+_CACHE_DIR = os.path.expanduser("~/.cache/syzkaller_tpu")
+
+
+class BuildError(Exception):
+    pass
+
+
+def _source_path(name: str) -> str:
+    return os.path.join(NATIVE_DIR, name)
+
+
+def build_executor(force: bool = False) -> str:
+    """Compile native/executor.cc; returns the cached binary path."""
+    src = _source_path("executor.cc")
+    with open(src, "rb") as f:
+        digest = hashlib.sha1(f.read()).hexdigest()[:16]
+    os.makedirs(_CACHE_DIR, exist_ok=True)
+    out = os.path.join(_CACHE_DIR, f"syz-executor-{digest}")
+    if os.path.exists(out) and not force:
+        return out
+    tmp = out + ".tmp"
+    base = ["g++", "-O2", "-pthread", "-Wall", "-Wno-unused-parameter",
+            src, "-o", tmp]
+    attempts = [base + ["-static"], base]
+    last = None
+    for cmd in attempts:
+        r = subprocess.run(cmd, capture_output=True, text=True)
+        if r.returncode == 0:
+            os.replace(tmp, out)
+            return out
+        last = r
+    raise BuildError(f"executor build failed:\n{last.stderr if last else ''}")

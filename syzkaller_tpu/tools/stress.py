@@ -1,0 +1,237 @@
+"""syz-stress equivalent: standalone gen/mutate/execute loop, no manager.
+
+Capability parity with reference tools/syz-stress/stress.go:42-88, wired
+the TPU way (SURVEY §7 step 6 / BASELINE config #1): programs run
+through the native executor; per-call coverage streams to the JAX
+engine, which does signal-diff + corpus admission + choice-table
+sampling in batched device steps.
+
+    python -m syzkaller_tpu.tools.stress -descriptions fixture -execs 2000
+"""
+
+from __future__ import annotations
+
+import argparse
+import threading
+import time
+from dataclasses import dataclass, field
+
+import numpy as np
+
+from syzkaller_tpu import ipc
+from syzkaller_tpu import prog as P
+from syzkaller_tpu.cover.engine import CoverageEngine
+from syzkaller_tpu.fuzzer import DeviceChoiceTable, PcMap
+from syzkaller_tpu.prog import model as M
+from syzkaller_tpu.sys.table import SyscallTable, load_table
+from syzkaller_tpu.utils import log
+
+DESC_SETS = {
+    "fixture": ["probe.txt"],
+    "linux": None,  # all descriptions
+    "all": None,
+}
+
+
+@dataclass
+class StressOptions:
+    descriptions: str = "fixture"
+    procs: int = 2
+    execs: int = 2000
+    ncalls: int = 12
+    seed: int = 0
+    threaded: bool = False
+    collide: bool = False
+    fake_cover: bool = True
+    npcs: int = 1 << 16
+    max_pcs_per_call: int = 256
+    flush_batch: int = 256        # exec-calls per device step (amortizes
+                                  # the ~100ms tunnel latency per jit call)
+    corpus_cap: int = 4096
+    log_every: float = 5.0
+    output: bool = False          # echo each program before executing
+    device_rand: bool = False     # draw program randomness on device
+
+
+@dataclass
+class StressStats:
+    execs: int = 0
+    exec_calls: int = 0
+    new_inputs: int = 0
+    corpus: list = field(default_factory=list)  # (serialized prog, call idx)
+    cover_pcs: int = 0
+
+
+class Stress:
+    def __init__(self, opts: StressOptions, table: "SyscallTable | None" = None):
+        self.opts = opts
+        self.table = table or load_table(files=DESC_SETS.get(
+            opts.descriptions, [opts.descriptions]))
+        self.engine = CoverageEngine(
+            npcs=opts.npcs, ncalls=self.table.count,
+            corpus_cap=opts.corpus_cap, batch=opts.flush_batch,
+            max_pcs_per_exec=opts.max_pcs_per_call, seed=opts.seed)
+        self.engine.set_priorities(P.calculate_priorities(self.table))
+        enabled = self.table.transitively_enabled_calls()
+        self.engine.set_enabled([c.id for c in enabled])
+        self.ct = DeviceChoiceTable(self.engine)
+        self.pcmap = PcMap(opts.npcs)
+        self.stats = StressStats()
+        self.corpus_progs: list[M.Prog] = []
+        self._lock = threading.Lock()
+        self._pending: list[tuple[bytes, int, int, np.ndarray]] = []
+        # (serialized prog, call_index, call_id, cover)
+        self._stop = False
+
+    def flags(self) -> int:
+        f = ipc.FLAG_COVER | ipc.FLAG_DEDUP_COVER
+        if self.opts.fake_cover:
+            f |= ipc.FLAG_FAKE_COVER
+        if self.opts.threaded:
+            f |= ipc.FLAG_THREADED
+        if self.opts.collide:
+            f |= ipc.FLAG_COLLIDE
+        return f
+
+    # -- the per-proc loop (ref stress.go:62-88) ---------------------------
+
+    def proc_loop(self, pid: int) -> None:
+        rand = P.Rand(np.random.default_rng(self.opts.seed * 1000 + pid))
+        if self.opts.device_rand:
+            rand.refill(self.engine.random_words(1 << 16))
+        env = ipc.Env(flags=self.flags(), pid=pid)
+        try:
+            while not self._stop:
+                with self._lock:
+                    if self.stats.execs >= self.opts.execs:
+                        break
+                    self.stats.execs += 1
+                    corpus = list(self.corpus_progs)
+                p = self.make_prog(rand, corpus)
+                if self.opts.output:
+                    log.logf(0, "executing program %d:\n%s", pid,
+                             P.serialize(p).decode())
+                try:
+                    res = env.exec(p)
+                except ipc.ExecutorFailure as e:
+                    log.logf(0, "executor failure: %s", e)
+                    continue
+                self.ingest(p, res)
+                if self.opts.device_rand and rand._pos >= len(rand._pool):
+                    rand.refill(self.engine.random_words(1 << 16))
+        finally:
+            env.close()
+
+    def make_prog(self, rand: P.Rand, corpus: list[M.Prog]) -> M.Prog:
+        if corpus and not rand.one_of(3):
+            p = M.clone_prog(corpus[rand.intn(len(corpus))])
+            P.mutate(p, rand, self.table, self.opts.ncalls, self.ct, corpus)
+            return p
+        return P.generate(rand, self.table, self.opts.ncalls, self.ct)
+
+    def ingest(self, p: M.Prog, res: ipc.ExecResult) -> None:
+        data = P.serialize(p)
+        with self._lock:
+            self.stats.exec_calls += len(res.calls)
+            for c in res.calls:
+                if c.index < len(p.calls) and len(c.cover):
+                    call_id = p.calls[c.index].meta.id
+                    self._pending.append((data, c.index, call_id, c.cover))
+            while len(self._pending) >= self.opts.flush_batch:
+                self.flush()
+
+    def flush(self) -> None:
+        """One fixed-shape device step for up to flush_batch pending exec
+        calls (called with lock). Short batches are padded — a varying
+        batch shape would trigger an XLA recompile per flush."""
+        B = self.opts.flush_batch
+        pend, self._pending = self._pending[:B], self._pending[B:]
+        if not pend:
+            return
+        covers = [cov for (_, _, _, cov) in pend]
+        covers += [np.zeros(0, np.uint32)] * (B - len(covers))
+        call_ids = np.zeros((B,), np.int32)
+        call_ids[: len(pend)] = [cid for (_, _, cid, _) in pend]
+        idx, valid = self.pcmap.map_batch(covers, self.opts.max_pcs_per_call)
+        result = self.engine.update_batch(call_ids, idx, valid)
+        new_rows = np.nonzero(result.has_new[: len(pend)])[0]
+        if len(new_rows) == 0:
+            return
+        if self.engine.admit_rows(result, call_ids, new_rows) is None:
+            # device corpus full: drop on the host side too so the two
+            # stay consistent (a manager-driven minimize frees space)
+            if not getattr(self, "_warned_full", False):
+                self._warned_full = True
+                log.logf(0, "corpus capacity %d reached; new inputs dropped",
+                         self.engine.cap)
+            return
+        for i in new_rows:
+            data, call_index, _cid, _cov = pend[i]
+            self.stats.new_inputs += 1
+            self.stats.corpus.append((data, call_index))
+            try:
+                self.corpus_progs.append(P.deserialize(data, self.table))
+            except P.DeserializeError:
+                pass
+
+    def run(self) -> StressStats:
+        threads = [threading.Thread(target=self.proc_loop, args=(pid,),
+                                    daemon=True)
+                   for pid in range(self.opts.procs)]
+        t0 = time.time()
+        last_log = t0
+        for t in threads:
+            t.start()
+        try:
+            while any(t.is_alive() for t in threads):
+                for t in threads:
+                    t.join(timeout=0.2)
+                now = time.time()
+                if now - last_log > self.opts.log_every:
+                    last_log = now
+                    with self._lock:
+                        rate = self.stats.execs / max(now - t0, 1e-9)
+                        log.logf(0, "execs %d (%.0f/sec) corpus %d cover %d",
+                                 self.stats.execs, rate,
+                                 len(self.stats.corpus),
+                                 int(self.engine.cover_counts().sum()))
+        except KeyboardInterrupt:
+            self._stop = True
+            for t in threads:
+                t.join(timeout=2.0)
+        with self._lock:
+            self.flush()
+            self.stats.cover_pcs = int(self.engine.cover_counts().sum())
+        return self.stats
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("-descriptions", default="fixture",
+                    help="fixture|linux|all or a description file name")
+    ap.add_argument("-procs", type=int, default=2)
+    ap.add_argument("-execs", type=int, default=2000)
+    ap.add_argument("-ncalls", type=int, default=12)
+    ap.add_argument("-seed", type=int, default=0)
+    ap.add_argument("-threaded", action="store_true")
+    ap.add_argument("-collide", action="store_true")
+    ap.add_argument("-real-cover", action="store_true",
+                    help="require KCOV instead of synthetic coverage")
+    ap.add_argument("-output", action="store_true")
+    ap.add_argument("-v", type=int, default=0)
+    args = ap.parse_args(argv)
+    log.set_verbosity(args.v)
+    opts = StressOptions(
+        descriptions=args.descriptions, procs=args.procs, execs=args.execs,
+        ncalls=args.ncalls, seed=args.seed, threaded=args.threaded,
+        collide=args.collide, fake_cover=not args.real_cover,
+        output=args.output)
+    st = Stress(opts)
+    stats = st.run()
+    log.logf(0, "done: execs %d calls %d new inputs %d covered PCs %d",
+             stats.execs, stats.exec_calls, stats.new_inputs, stats.cover_pcs)
+    return stats
+
+
+if __name__ == "__main__":
+    main()
