@@ -728,8 +728,20 @@ static int run_worker(Prog* p)
 	for (;;) {
 		int status = 0;
 		int res = waitpid(pid, &status, WNOHANG);
-		if (res == pid)
-			return WIFEXITED(status) ? WEXITSTATUS(status) : kFailStatus;
+		if (res == pid) {
+			// Only the magic statuses speak the protocol; any other
+			// exit (including signal death — routine when fuzzing)
+			// is a test outcome, not an executor failure.  Programs
+			// can call exit() themselves; sanitize_call rewrites
+			// 67/68/69 exit args so these remain ours.
+			if (WIFEXITED(status)) {
+				int code = WEXITSTATUS(status);
+				if (code == kFailStatus || code == kErrorStatus ||
+				    code == kRetryStatus)
+					return code;
+			}
+			return 0;
+		}
 		usleep(1000);
 		clock_gettime(CLOCK_MONOTONIC, &ts);
 		uint64_t now = ts.tv_sec * 1000ull + ts.tv_nsec / 1000000;

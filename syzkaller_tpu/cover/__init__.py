@@ -1,6 +1,9 @@
-"""Coverage: device-resident bitmap engine + host sorted-set reference."""
+"""Coverage: device-resident bitmap engine + host sorted-set reference.
+
+The jax-backed engine lives in syzkaller_tpu.cover.engine and is
+imported directly by device-side components (manager, stress, bench);
+this package init stays jax-free so guest-side code (the in-VM fuzzer)
+can use the numpy sorted-set algebra without pulling in jax.
+"""
 
 from syzkaller_tpu.cover import sets  # noqa: F401
-from syzkaller_tpu.cover.engine import (  # noqa: F401
-    CoverageEngine, nwords_for, pack_pcs, sample_calls, signal_diff,
-)

@@ -172,6 +172,18 @@ def random_words(key: jax.Array, n: int) -> np.ndarray:
 # The stateful engine: device arrays + jitted steps.
 
 
+def _locked(fn):
+    """Serialize stateful engine ops: they donate buffers to XLA, so a
+    second thread entering mid-call would touch a deleted array."""
+
+    @functools.wraps(fn)
+    def wrapper(self, *args, **kwargs):
+        with self._state_mu:
+            return fn(self, *args, **kwargs)
+
+    return wrapper
+
+
 @dataclass
 class UpdateResult:
     has_new: np.ndarray     # (B,) bool — new signal vs max cover
@@ -199,6 +211,7 @@ class CoverageEngine:
         self.mesh = mesh
         self.key = jax.random.PRNGKey(seed)
         self._key_mu = threading.Lock()
+        self._state_mu = threading.RLock()
 
         shape_cover = (ncalls, self.W)
         self.max_cover = jnp.zeros(shape_cover, jnp.uint32)
@@ -282,6 +295,20 @@ class CoverageEngine:
         def _minimize(corpus_mat, active):
             return minimize_cover(corpus_mat, active)
 
+        @functools.partial(jax.jit, donate_argnums=(0,))
+        def _compact(corpus_mat, keep_mask, corpus_call):
+            # compact kept rows to the front; rebuild per-call cover as
+            # the or-union of the survivors
+            idx = jnp.cumsum(keep_mask.astype(jnp.int32)) - 1
+            idx = jnp.where(keep_mask, idx, corpus_mat.shape[0])
+            rows = jnp.where(keep_mask[:, None], corpus_mat, jnp.uint32(0))
+            new_mat = jnp.zeros_like(corpus_mat).at[idx].set(
+                corpus_mat, mode="drop")
+            cover = scatter_or(
+                jnp.zeros((self.ncalls, corpus_mat.shape[1]), jnp.uint32),
+                corpus_call, rows)
+            return new_mat, cover
+
         @jax.jit
         def _sample(key, probs, prev, enabled):
             return sample_calls(key, probs, prev, enabled)
@@ -307,6 +334,7 @@ class CoverageEngine:
         self._diff_vs_fn = _diff_vs
         self._admit_fn = _admit
         self._minimize_fn = _minimize
+        self._compact_fn = _compact
         self._sample_fn = _sample
         self._prio_update_fn = _prio_update
 
@@ -318,6 +346,7 @@ class CoverageEngine:
         valid = jnp.asarray(valid, jnp.bool_)
         return call_ids, pc_idx, valid
 
+    @_locked
     def update_batch(self, call_ids, pc_idx, valid) -> UpdateResult:
         """The hot step: B execs' coverage in, per-exec new-signal verdicts
         out; max-cover merged in place (single fused jit call).
@@ -329,6 +358,7 @@ class CoverageEngine:
         return UpdateResult(has_new=np.asarray(has_new), new_bits=new,
                             bitmaps=bitmaps)
 
+    @_locked
     def admit_rows(self, result: UpdateResult, call_ids,
                    rows) -> "np.ndarray | None":
         """Admit selected exec rows of an update_batch result into the
@@ -355,6 +385,7 @@ class CoverageEngine:
         self.corpus_len += n
         return idx
 
+    @_locked
     def triage_diff(self, call_ids, pc_idx, valid):
         """Diff vs corpus cover minus flakes (ref triageInput
         fuzzer.go:384-386); no state mutation."""
@@ -363,10 +394,12 @@ class CoverageEngine:
             self.corpus_cover, call_ids, pc_idx, valid, self.flakes)
         return np.asarray(has_new), new, bitmaps
 
+    @_locked
     def add_flakes(self, call_ids, bitmaps) -> None:
         call_ids = jnp.asarray(call_ids, jnp.int32)
         self.flakes = self._or_rows_fn(self.flakes, call_ids, bitmaps)
 
+    @_locked
     def merge_corpus(self, call_ids, bitmaps) -> "np.ndarray | None":
         """Admit execs into corpus cover + the corpus signal matrix.
         Returns indices assigned (None if corpus is full — nothing is
@@ -384,12 +417,35 @@ class CoverageEngine:
         self.corpus_len += n
         return idx
 
+    @_locked
     def minimize_corpus(self) -> np.ndarray:
         """(cap,) keep mask over the admitted corpus rows."""
         active = np.zeros((self.cap,), bool)
         active[: self.corpus_len] = True
         keep = self._minimize_fn(self.corpus_mat, jnp.asarray(active))
         return np.asarray(keep)
+
+    @_locked
+    def compact_corpus(self, keep_mask: np.ndarray) -> dict[int, int]:
+        """Drop corpus rows not in keep_mask, compacting the signal matrix
+        and rebuilding corpus cover from the survivors — this is what
+        actually frees admission capacity after a minimize pass.
+        Returns the old-row → new-row mapping."""
+        keep_mask = np.asarray(keep_mask, bool).copy()
+        keep_mask[self.corpus_len:] = False
+        old_rows = np.nonzero(keep_mask)[0]
+        mapping = {int(o): i for i, o in enumerate(old_rows)}
+        n = len(old_rows)
+        new_mat, new_cover = self._compact_fn(
+            self.corpus_mat, jnp.asarray(keep_mask),
+            jnp.asarray(self.corpus_call))
+        self.corpus_mat = new_mat
+        self.corpus_cover = new_cover
+        new_call = np.zeros_like(self.corpus_call)
+        new_call[:n] = self.corpus_call[old_rows]
+        self.corpus_call = new_call
+        self.corpus_len = n
+        return mapping
 
     def set_priorities(self, static_prios: np.ndarray,
                        call_matrix: "np.ndarray | None" = None) -> None:
@@ -422,10 +478,12 @@ class CoverageEngine:
 
     # -- introspection ---------------------------------------------------
 
+    @_locked
     def cover_counts(self) -> np.ndarray:
         """(ncalls,) covered-PC counts (for stats/UI)."""
         return np.asarray(self._popcount_fn(self.corpus_cover))
 
+    @_locked
     def max_cover_pcs(self, call_id: int) -> np.ndarray:
         """Unpack one call's max-cover bitmap to sorted PC indices."""
         row = np.asarray(self.max_cover[call_id])

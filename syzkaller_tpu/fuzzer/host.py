@@ -1,0 +1,71 @@
+"""Host capability detection: which calls can this kernel execute.
+
+Capability parity with reference host/host.go:19-157: kallsyms scan for
+syscall entry points, with pseudo-call knowledge (syz_probe* are
+executor no-ops, so always "supported"; real syz_* helpers depend on
+device files). Falls back to "everything supported" when kallsyms is
+unreadable (non-root/containers), as the closure pass still prunes
+uncreatable resources.
+"""
+
+from __future__ import annotations
+
+import functools
+import os
+
+from syzkaller_tpu.sys import types as T
+from syzkaller_tpu.sys.table import SyscallTable
+
+_PSEUDO_DEVICES = {
+    "syz_open_dev": None,       # checked per-arg at generation time
+    "syz_open_pts": "/dev/ptmx",
+    "syz_fuse_mount": "/dev/fuse",
+    "syz_fuseblk_mount": "/dev/fuse",
+    "syz_emit_ethernet": "/dev/net/tun",
+    "syz_kvm_setup_cpu": "/dev/kvm",
+}
+
+
+@functools.lru_cache(maxsize=None)
+def _kallsyms() -> "frozenset[str] | None":
+    try:
+        with open("/proc/kallsyms", "rb") as f:
+            data = f.read()
+    except OSError:
+        return None
+    if not data:
+        return None
+    syms = set()
+    for line in data.splitlines():
+        parts = line.split()
+        if len(parts) >= 3:
+            syms.add(parts[2].decode(errors="replace"))
+    return frozenset(syms)
+
+
+def _syscall_supported(name: str, syms: "frozenset[str] | None") -> bool:
+    if syms is None:
+        return True
+    for pat in (f"sys_{name}", f"__x64_sys_{name}", f"__se_sys_{name}",
+                f"__arm64_sys_{name}", f"ksys_{name}"):
+        if pat in syms:
+            return True
+    # compat/indirect entries (socketcall etc.) or inlined wrappers:
+    # absence in kallsyms is not definitive, be permissive for common ones
+    return name in ("mmap", "munmap", "read", "write", "open", "close",
+                    "exit", "exit_group")
+
+
+def detect_supported(table: SyscallTable) -> set[T.Syscall]:
+    syms = _kallsyms()
+    out: set[T.Syscall] = set()
+    for call in table.calls:
+        name = call.call_name
+        if name.startswith("syz_"):
+            dev = _PSEUDO_DEVICES.get(name)
+            if dev is not None and not os.path.exists(dev):
+                continue
+            out.add(call)  # executor handles unknown pseudo-calls as no-ops
+        elif _syscall_supported(name, syms):
+            out.add(call)
+    return out

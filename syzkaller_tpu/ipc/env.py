@@ -278,6 +278,7 @@ class Gate:
                     and not any(self._running)):
                 run_cb = True
                 self._in_callback = True
+            self._cv.notify_all()
         if run_cb:
             try:
                 self.callback()

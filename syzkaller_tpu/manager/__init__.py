@@ -1,0 +1,5 @@
+"""The manager tier: orchestration, persistence, stats UI."""
+
+from syzkaller_tpu.manager.config import Config, ConfigError, load, loads  # noqa: F401
+from syzkaller_tpu.manager.manager import Manager  # noqa: F401
+from syzkaller_tpu.manager.persistent import PersistentSet  # noqa: F401

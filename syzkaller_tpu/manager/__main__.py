@@ -1,0 +1,29 @@
+"""CLI: python -m syzkaller_tpu.manager -config manager.cfg"""
+
+import argparse
+
+from syzkaller_tpu.manager import config as config_mod
+from syzkaller_tpu.manager.manager import Manager
+from syzkaller_tpu.utils import log
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser()
+    ap.add_argument("-config", required=True)
+    ap.add_argument("-duration", type=float, default=None,
+                    help="seconds to run (default: forever)")
+    ap.add_argument("-v", type=int, default=0)
+    args = ap.parse_args(argv)
+    log.set_verbosity(args.v)
+    log.enable_log_caching()
+    cfg = config_mod.load(args.config)
+    Manager(cfg).run(args.duration)
+    # Skip interpreter teardown: in-flight RPC handler threads inside
+    # device calls make the TPU runtime abort on normal exit.
+    import os
+
+    os._exit(0)
+
+
+if __name__ == "__main__":
+    main()

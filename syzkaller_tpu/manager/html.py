@@ -1,0 +1,125 @@
+"""Manager HTTP UI: live stats, corpus browser, crash and prio views.
+
+Capability parity with reference syz-manager/html.go:30-124: summary
+page (uptime, stats, crash table, per-call corpus counts), /corpus,
+/crash, /prio matrix view, and /log (the in-memory log cache).
+"""
+
+from __future__ import annotations
+
+import html as html_mod
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from urllib.parse import parse_qs, urlparse
+
+import numpy as np
+
+from syzkaller_tpu.utils import log
+
+
+def serve(mgr, host: str, port: int) -> ThreadingHTTPServer:
+    class Handler(BaseHTTPRequestHandler):
+        def log_message(self, *args):
+            pass
+
+        def _send(self, body: str, code: int = 200):
+            data = body.encode()
+            self.send_response(code)
+            self.send_header("Content-Type", "text/html; charset=utf-8")
+            self.send_header("Content-Length", str(len(data)))
+            self.end_headers()
+            self.wfile.write(data)
+
+        def do_GET(self):
+            u = urlparse(self.path)
+            q = parse_qs(u.query)
+            try:
+                if u.path == "/":
+                    self._send(summary(mgr))
+                elif u.path == "/corpus":
+                    self._send(corpus(mgr))
+                elif u.path == "/crash":
+                    self._send(crash(mgr, q.get("id", [""])[0]))
+                elif u.path == "/prio":
+                    self._send(prio(mgr, q.get("call", [""])[0]))
+                elif u.path == "/log":
+                    self._send("<pre>%s</pre>" %
+                               html_mod.escape(log.cached_log()))
+                else:
+                    self._send("not found", 404)
+            except Exception as e:  # UI must not kill the manager
+                self._send(f"error: {html_mod.escape(str(e))}", 500)
+
+    srv = ThreadingHTTPServer((host, port), Handler)
+    t = threading.Thread(target=srv.serve_forever, daemon=True)
+    t.start()
+    log.logf(0, "http UI on http://%s:%d", *srv.server_address)
+    return srv
+
+
+_STYLE = """<style>
+body { font-family: monospace; margin: 1em; }
+table { border-collapse: collapse; }
+td, th { border: 1px solid #999; padding: 2px 8px; text-align: left; }
+</style>"""
+
+
+def _esc(s) -> str:
+    return html_mod.escape(str(s))
+
+
+def summary(mgr) -> str:
+    up = int(time.time() - mgr.start_time)
+    with mgr._mu:
+        stats = dict(mgr.stats)
+        crashes = dict(mgr.crash_types)
+        ncorpus = len(mgr.corpus)
+        fuzzers = list(mgr.fuzzers)
+    cover = int(mgr.engine.cover_counts().sum())
+    rows = "".join(f"<tr><td>{_esc(k)}</td><td>{v}</td></tr>"
+                   for k, v in sorted(stats.items()))
+    crows = "".join(
+        f"<tr><td><a href='/crash?id={_esc(t)}'>{_esc(t)}</a></td>"
+        f"<td>{n}</td></tr>" for t, n in sorted(crashes.items()))
+    return (f"{_STYLE}<h2>{_esc(mgr.cfg.name)}</h2>"
+            f"<p>uptime {up // 3600}h{(up % 3600) // 60}m, "
+            f"corpus <a href='/corpus'>{ncorpus}</a>, cover {cover}, "
+            f"fuzzers {_esc(fuzzers)}</p>"
+            f"<p><a href='/prio'>priorities</a> | <a href='/log'>log</a></p>"
+            f"<h3>Stats</h3><table>{rows}</table>"
+            f"<h3>Crashes</h3><table><tr><th>description</th><th>count</th>"
+            f"</tr>{crows}</table>")
+
+
+def corpus(mgr) -> str:
+    with mgr._mu:
+        items = list(mgr.corpus.values())[:1000]
+    rows = "".join(
+        f"<tr><td>{_esc(it.call)}</td>"
+        f"<td><pre>{_esc(it.data.decode(errors='replace'))}</pre></td></tr>"
+        for it in items)
+    return f"{_STYLE}<h2>corpus ({len(items)} shown)</h2><table>{rows}</table>"
+
+
+def crash(mgr, title: str) -> str:
+    with mgr._mu:
+        count = mgr.crash_types.get(title, 0)
+    return (f"{_STYLE}<h2>{_esc(title)}</h2><p>count: {count}; "
+            f"logs under workdir/crashes/</p>")
+
+
+def prio(mgr, call: str) -> str:
+    prios = np.asarray(mgr.engine.prios)
+    table = mgr.table
+    if call and call in table.call_map:
+        cid = table.call_map[call].id
+        pairs = sorted(((prios[cid, j], table.calls[j].name)
+                        for j in range(table.count)), reverse=True)[:50]
+        rows = "".join(f"<tr><td>{_esc(n)}</td><td>{p:.3f}</td></tr>"
+                       for p, n in pairs)
+        return (f"{_STYLE}<h2>priorities from {_esc(call)}</h2>"
+                f"<table>{rows}</table>")
+    links = "".join(f"<li><a href='/prio?call={_esc(c.name)}'>"
+                    f"{_esc(c.name)}</a></li>" for c in table.calls[:500])
+    return f"{_STYLE}<h2>priority matrix</h2><ul>{links}</ul>"

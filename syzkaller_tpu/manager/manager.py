@@ -1,0 +1,395 @@
+"""The manager: VM fleet orchestration, global corpus, crash triage.
+
+Capability parity with reference syz-manager/manager.go: persistent
+corpus loaded as re-triage candidates (:124-157), RPC service
+{Connect, Check, Poll, NewInput} (:552-656), per-VM run loop with
+monitor + reboot (:230-341), crash persistence with the 100-report cap
+(:408-450), corpus minimization (:504-550), and stats aggregation
+(:628-630).
+
+TPU-native: the manager owns the device-resident global coverage
+engine; NewInput admission is a device signal-diff, corpus minimization
+is the device greedy set cover, and Poll hands fuzzers batches of
+device-drawn choice-table decisions (BASELINE north star).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import os
+import shlex
+import sys
+import threading
+import time
+from collections import deque
+from dataclasses import dataclass, field
+
+import numpy as np
+
+from syzkaller_tpu import prog as P
+from syzkaller_tpu import rpc, vm
+from syzkaller_tpu.cover.engine import CoverageEngine
+from syzkaller_tpu.fuzzer import PcMap
+from syzkaller_tpu.manager.config import Config
+from syzkaller_tpu.manager.persistent import PersistentSet
+from syzkaller_tpu.report import symbolize_report
+from syzkaller_tpu.sys.table import load_table
+from syzkaller_tpu.utils import log
+from syzkaller_tpu.vm.monitor import monitor_execution
+
+VM_RUN_TIME = 60 * 60.0       # reboot VMs hourly; normal outcome (ref :376)
+MAX_CRASH_LOGS = 100          # ref manager.go:408-450
+CANDIDATES_PER_POLL = 10
+INPUTS_PER_POLL = 100
+CHOICES_PER_POLL = 64
+
+
+@dataclass
+class FuzzerConn:
+    name: str
+    input_queue: deque = field(default_factory=deque)
+    connected_at: float = field(default_factory=time.time)
+    calls: list = field(default_factory=list)
+
+
+@dataclass
+class CorpusItem:
+    data: bytes
+    call: str
+    call_index: int
+    corpus_row: int = -1
+
+
+class Manager:
+    def __init__(self, cfg: Config, table=None):
+        self.cfg = cfg
+        os.makedirs(cfg.workdir, exist_ok=True)
+        self.crashdir = os.path.join(cfg.workdir, "crashes")
+        os.makedirs(self.crashdir, exist_ok=True)
+        self.table = table or load_table(
+            files=None if cfg.descriptions in ("all", "linux")
+            else [cfg.descriptions])
+
+        self.engine = CoverageEngine(
+            npcs=cfg.npcs, ncalls=self.table.count,
+            corpus_cap=cfg.corpus_cap, batch=cfg.flush_batch)
+        self.static_prios = P.calculate_priorities(self.table)
+        self.engine.set_priorities(self.static_prios)
+        self.enabled_names = cfg.enabled_calls(self.table)
+        self.engine.set_enabled(
+            [self.table.call_map[n].id for n in self.enabled_names])
+        self.pcmap = PcMap(cfg.npcs)
+
+        def verify(data: bytes) -> bool:
+            try:
+                return len(P.deserialize(data, self.table).calls) > 0
+            except P.DeserializeError:
+                return False
+
+        self.persistent = PersistentSet(
+            os.path.join(cfg.workdir, "corpus"), verify)
+        # on restart the corpus is re-triaged as candidates so device
+        # coverage state is rebuilt (ref manager.go:124-157; SURVEY §5
+        # checkpoint/resume: the device matrix is a cache)
+        self.candidates: deque[bytes] = deque(self.persistent.values())
+        self.corpus: dict[bytes, CorpusItem] = {}
+
+        self.fuzzers: dict[str, FuzzerConn] = {}
+        self.stats: dict[str, int] = {}
+        self.crash_types: dict[str, int] = {}
+        self.start_time = time.time()
+        self._mu = threading.Lock()
+        self._admit_mu = threading.Lock()
+        self._stop = False
+        self._last_prio_update = 0.0
+        self._instances: dict[int, vm.Instance] = {}
+
+        self.server = rpc.RpcServer(*self._split_addr(cfg.rpc))
+        self.server.register("Manager.Connect", self.rpc_connect)
+        self.server.register("Manager.Check", self.rpc_check)
+        self.server.register("Manager.Poll", self.rpc_poll)
+        self.server.register("Manager.NewInput", self.rpc_new_input)
+        self.rpc_port = self.server.addr[1]
+        self.http_server = None
+        self.vm_threads: list[threading.Thread] = []
+
+    @staticmethod
+    def _split_addr(addr: str) -> tuple[str, int]:
+        host, _, port = addr.rpartition(":")
+        return host or "127.0.0.1", int(port or 0)
+
+    # -- RPC handlers (ref manager.go:552-656) -----------------------------
+
+    def rpc_connect(self, params: dict) -> dict:
+        name = params.get("name", "?")
+        with self._mu:
+            self.fuzzers[name] = FuzzerConn(name=name)
+            cands = self._pop_candidates(CANDIDATES_PER_POLL)
+        log.logf(0, "fuzzer %s connected", name)
+        return {
+            "prios": rpc.b64(np.asarray(self.engine.prios, np.float32)
+                             .tobytes()),
+            "enabled": self.enabled_names,
+            "candidates": cands,
+        }
+
+    def rpc_check(self, params: dict) -> dict:
+        name = params.get("name", "?")
+        with self._mu:
+            conn = self.fuzzers.get(name)
+            if conn is not None:
+                conn.calls = params.get("calls", [])
+        log.logf(0, "fuzzer %s: %d enabled calls after closure",
+                 name, len(params.get("calls", [])))
+        return {}
+
+    def _pop_candidates(self, n: int) -> list[dict]:
+        out = []
+        while self.candidates and len(out) < n:
+            data = self.candidates.popleft()
+            out.append({"prog": rpc.b64(data), "minimized": True})
+        return out
+
+    def rpc_poll(self, params: dict) -> dict:
+        name = params.get("name", "?")
+        for k, v in (params.get("stats") or {}).items():
+            with self._mu:
+                self.stats[k] = self.stats.get(k, 0) + int(v)
+        with self._mu:
+            conn = self.fuzzers.get(name)
+            if conn is None:
+                conn = self.fuzzers[name] = FuzzerConn(name=name)
+            inputs = []
+            while conn.input_queue and len(inputs) < INPUTS_PER_POLL:
+                inputs.append(conn.input_queue.popleft())
+            cands = (self._pop_candidates(CANDIDATES_PER_POLL)
+                     if params.get("need_candidates") else [])
+        choices = self.engine.sample_next_calls(
+            np.full((CHOICES_PER_POLL,), -1, np.int32))
+        return {"candidates": cands, "new_inputs": inputs,
+                "choices": [int(x) for x in choices]}
+
+    def rpc_new_input(self, params: dict) -> dict:
+        name = params.get("name", "?")
+        data = rpc.unb64(params.get("prog", ""))
+        call = params.get("call", "")
+        call_index = int(params.get("call_index", 0))
+        cover = np.array(params.get("cover", []), dtype=np.uint64)
+        sig = hashlib.sha1(data).digest()
+        meta = self.table.call_map.get(call)
+        if meta is None:
+            return {}
+        # one admission at a time: concurrent duplicates would both pass
+        # the diff gate before either merged (TOCTOU)
+        with self._admit_mu:
+            with self._mu:
+                if sig in self.corpus:
+                    return {}
+            # device admission gate: diff vs global corpus cover
+            idx, valid = self.pcmap.map_batch([cover], K=256)
+            has_new, _new, bitmaps = self.engine.triage_diff(
+                np.array([meta.id], np.int32), idx, valid)
+            if not has_new[0]:
+                with self._mu:
+                    self.stats["rejected inputs"] = \
+                        self.stats.get("rejected inputs", 0) + 1
+                return {}
+            rows = self.engine.merge_corpus(np.array([meta.id], np.int32),
+                                            bitmaps)
+            with self._mu:
+                self.corpus[sig] = CorpusItem(
+                    data=data, call=call, call_index=call_index,
+                    corpus_row=int(rows[0]) if rows is not None else -1)
+                self.stats["manager new inputs"] = \
+                    self.stats.get("manager new inputs", 0) + 1
+                # broadcast to the other fuzzers (ref manager.go:596-621)
+                wire = {"prog": params.get("prog"), "call": call,
+                        "call_index": call_index,
+                        "cover": params.get("cover", [])}
+                for other, conn in self.fuzzers.items():
+                    if other != name:
+                        conn.input_queue.append(wire)
+        self.persistent.add(data)
+        self._maybe_update_prios()
+        return {}
+
+    def _maybe_update_prios(self) -> None:
+        """Periodic dynamic-priority refresh: one MXU matmul over the
+        corpus occurrence matrix (ref CalculatePriorities, device-side)."""
+        now = time.time()
+        with self._mu:
+            if now - self._last_prio_update < 30.0 or not self.corpus:
+                return
+            self._last_prio_update = now
+            items = list(self.corpus.values())
+        call_mat = np.zeros((len(items), self.table.count), np.float32)
+        for i, item in enumerate(items):
+            try:
+                for cname in P.call_set(item.data):
+                    m = self.table.call_map.get(cname)
+                    if m is not None:
+                        call_mat[i, m.id] = 1.0
+            except Exception:
+                continue
+        self.engine.set_priorities(self.static_prios, call_mat)
+
+    # -- corpus minimization (ref manager.go:504-550) ----------------------
+
+    def minimize_corpus(self) -> int:
+        """Greedy set cover on device; drops subsumed corpus programs and
+        compacts the device matrix so admission capacity is reclaimed."""
+        with self._admit_mu:
+            if not self.corpus or self.engine.corpus_len == 0:
+                return 0
+            keep_mask = self.engine.minimize_corpus()
+            mapping = self.engine.compact_corpus(keep_mask)
+            removed = 0
+            with self._mu:
+                for sig, item in list(self.corpus.items()):
+                    new_row = mapping.get(item.corpus_row)
+                    if item.corpus_row >= 0 and new_row is None:
+                        del self.corpus[sig]
+                        removed += 1
+                    elif new_row is not None:
+                        item.corpus_row = new_row
+                keep_data = [i.data for i in self.corpus.values()]
+        if removed:
+            self.persistent.minimize(keep_data)
+            log.logf(0, "corpus minimized: removed %d programs", removed)
+        return removed
+
+    # -- crash persistence (ref manager.go:408-502) ------------------------
+
+    def save_crash(self, outcome) -> str:
+        title = outcome.title
+        dirname = hashlib.sha1(title.encode()).hexdigest()[:40]
+        d = os.path.join(self.crashdir, dirname)
+        os.makedirs(d, exist_ok=True)
+        with open(os.path.join(d, "description"), "w") as f:
+            f.write(title + "\n")
+        for i in range(MAX_CRASH_LOGS):
+            logp = os.path.join(d, f"log{i}")
+            if not os.path.exists(logp):
+                with open(logp, "wb") as f:
+                    f.write(outcome.output)
+                if outcome.report is not None:
+                    text = outcome.report.text
+                    if self.cfg.vmlinux:
+                        try:
+                            text = symbolize_report(text, self.cfg.vmlinux)
+                        except Exception as e:
+                            log.logf(1, "symbolization failed: %s", e)
+                    with open(os.path.join(d, f"report{i}"), "wb") as f:
+                        f.write(text)
+                break
+        with self._mu:
+            self.crash_types[title] = self.crash_types.get(title, 0) + 1
+            self.stats["crashes"] = self.stats.get("crashes", 0) + 1
+        log.logf(0, "vm crash: %s", title)
+        return d
+
+    # -- VM loop (ref manager.go:230-341) ----------------------------------
+
+    def fuzzer_cmdline(self, index: int, manager_addr: str) -> str:
+        a = [sys.executable, "-m", "syzkaller_tpu.fuzzer.fuzzer",
+             "-name", f"vm{index}", "-manager", manager_addr,
+             "-procs", str(self.cfg.procs),
+             "-descriptions", self.cfg.descriptions,
+             "-output", "stdout", "-seed", str(index)]
+        if self.cfg.sandbox != "none":
+            a += ["-sandbox", self.cfg.sandbox]
+        if self.cfg.threaded:
+            a.append("-threaded")
+        if self.cfg.collide:
+            a.append("-collide")
+        if not self.cfg.fake_cover:
+            a.append("-real-cover")
+        if self.cfg.leak:
+            a.append("-leak")
+        return " ".join(shlex.quote(x) for x in a)
+
+    def vm_loop(self, index: int) -> None:
+        suppressions = self.cfg.compiled_suppressions()
+        while not self._stop:
+            inst = None
+            try:
+                inst = vm.create(self.cfg.type, self.cfg, index)
+                with self._mu:
+                    self._instances[index] = inst
+                addr = inst.forward(self.rpc_port)
+                cmd = self.fuzzer_cmdline(index, addr)
+                handle = inst.run(cmd, timeout=VM_RUN_TIME)
+                outcome = monitor_execution(handle, VM_RUN_TIME,
+                                            ignores=suppressions)
+                handle.stop()
+                # shutdown kills the fuzzer: its EOF is not a crash
+                if outcome.crashed and not self._stop:
+                    self.save_crash(outcome)
+            except Exception as e:
+                log.logf(0, "vm-%d error: %s", index, e)
+                time.sleep(5.0)
+            finally:
+                with self._mu:
+                    self._instances.pop(index, None)
+                if inst is not None:
+                    try:
+                        inst.close()
+                    except Exception:
+                        pass
+            with self._mu:
+                self.fuzzers.pop(f"vm{index}", None)
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def start(self) -> None:
+        self.server.serve_background()
+        if self.cfg.http:
+            from syzkaller_tpu.manager import html
+            self.http_server = html.serve(self, *self._split_addr(self.cfg.http))
+        for i in range(self.cfg.count):
+            t = threading.Thread(target=self.vm_loop, args=(i,), daemon=True)
+            t.start()
+            self.vm_threads.append(t)
+        log.logf(0, "manager up: rpc :%d, %d %s VM(s), %d corpus candidates",
+                 self.rpc_port, self.cfg.count, self.cfg.type,
+                 len(self.candidates))
+
+    def run(self, duration: "float | None" = None) -> None:
+        self.start()
+        deadline = time.time() + duration if duration else None
+        last_stats = time.time()
+        last_minimize = time.time()
+        try:
+            while not self._stop:
+                time.sleep(1.0)
+                if deadline and time.time() > deadline:
+                    break
+                if time.time() - last_stats > 10.0:
+                    last_stats = time.time()
+                    with self._mu:
+                        execs = self.stats.get("exec total", 0)
+                        crashes = self.stats.get("crashes", 0)
+                    log.logf(0, "executed %d programs, %d crashes, "
+                             "corpus %d, cover %d",
+                             execs, crashes, len(self.corpus),
+                             int(self.engine.cover_counts().sum()))
+                if time.time() - last_minimize > 300.0:
+                    last_minimize = time.time()
+                    self.minimize_corpus()
+        finally:
+            self.stop()
+
+    def stop(self) -> None:
+        self._stop = True
+        with self._mu:
+            instances = list(self._instances.values())
+        for inst in instances:
+            try:
+                inst.close()  # kills the fuzzer; monitor sees EOF and exits
+            except Exception:
+                pass
+        self.server.close()
+        if self.http_server is not None:
+            self.http_server.shutdown()
+        for t in self.vm_threads:
+            t.join(timeout=10.0)

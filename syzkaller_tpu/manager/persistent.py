@@ -1,0 +1,73 @@
+"""Persistent corpus: a directory of sha1-named serialized programs.
+
+Capability parity with reference syz-manager/persistent.go:15-102:
+verify-on-load (stale programs that no longer parse are garbage
+collected), content-hash naming, add, and minimize-to-subset.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import os
+
+from syzkaller_tpu.utils import log
+
+
+def _sig(data: bytes) -> str:
+    return hashlib.sha1(data).hexdigest()
+
+
+class PersistentSet:
+    def __init__(self, dirpath: str, verify=None):
+        """verify: fn(data) -> bool; failing entries are deleted."""
+        self.dir = dirpath
+        os.makedirs(dirpath, exist_ok=True)
+        self.entries: dict[str, bytes] = {}
+        bad = 0
+        for name in sorted(os.listdir(dirpath)):
+            path = os.path.join(dirpath, name)
+            if not os.path.isfile(path):
+                continue
+            with open(path, "rb") as f:
+                data = f.read()
+            if _sig(data) != name or (verify is not None and not verify(data)):
+                bad += 1
+                os.unlink(path)
+                continue
+            self.entries[name] = data
+        if bad:
+            log.logf(0, "corpus: removed %d broken/stale programs", bad)
+
+    def __len__(self) -> int:
+        return len(self.entries)
+
+    def __contains__(self, data: bytes) -> bool:
+        return _sig(data) in self.entries
+
+    def values(self) -> list[bytes]:
+        return list(self.entries.values())
+
+    def add(self, data: bytes) -> bool:
+        sig = _sig(data)
+        if sig in self.entries:
+            return False
+        self.entries[sig] = data
+        tmp = os.path.join(self.dir, f".tmp.{sig}")
+        with open(tmp, "wb") as f:
+            f.write(data)
+        os.replace(tmp, os.path.join(self.dir, sig))
+        return True
+
+    def minimize(self, keep: "list[bytes]") -> int:
+        """Drop everything not in `keep`; returns number removed."""
+        keep_sigs = {_sig(d) for d in keep}
+        removed = 0
+        for sig in list(self.entries):
+            if sig not in keep_sigs:
+                del self.entries[sig]
+                try:
+                    os.unlink(os.path.join(self.dir, sig))
+                except OSError:
+                    pass
+                removed += 1
+        return removed

@@ -241,15 +241,17 @@ def minimize(p: M.Prog, call_index: int, pred: Pred,
              crash_mode: bool = False) -> tuple[M.Prog, int]:
     """Shrink p while pred(p, call_index) stays true.  pred re-executes the
     candidate (dozens of kernel round-trips — ref fuzzer.go:421-435); the
-    tried-paths memo keeps the number of attempts linear-ish."""
+    tried-paths memo keeps the number of attempts linear-ish.
+    call_index == -1 (crash mode, ref repro.go:193-200): no call is
+    pinned — any call may go as long as the predicate holds."""
     p = M.clone_prog(p)
     # 1. Call removal, from the end (later calls can't be depended on).
     i = len(p.calls) - 1
     while i >= 0:
-        if i != call_index:
+        if i != call_index and len(p.calls) > 1:
             q = M.clone_prog(p)
             M.remove_call(q, i)
-            ni = call_index - 1 if i < call_index else call_index
+            ni = call_index - 1 if 0 <= i < call_index else call_index
             if pred(q, ni):
                 p, call_index = q, ni
         i -= 1

@@ -1,0 +1,205 @@
+"""Kernel crash parsing: oops detection + description extraction.
+
+Capability parity with reference report/report.go:29-307: a table of
+oops classes (BUG:/WARNING:/INFO:/GPF/panic/...), each with
+regex→format templates that extract a stable crash *description* (the
+dedup key for crash dirs), per-class suppressions, and the
+ContainsCrash/Parse entry points over raw console output.
+"""
+
+from __future__ import annotations
+
+import re
+from dataclasses import dataclass, field
+
+
+def _compile(pat: str) -> "re.Pattern[bytes]":
+    pat = pat.replace("{{ADDR}}", r"0x[0-9a-f]+")
+    pat = pat.replace("{{PC}}", r"\[\<[0-9a-f]+\>\]")
+    pat = pat.replace("{{FUNC}}", r"([a-zA-Z0-9_]+)(?:\.|\+)")
+    pat = pat.replace("{{SRC}}", r"([a-zA-Z0-9-_/.]+\.[a-z]+:[0-9]+)")
+    return re.compile(pat.encode())
+
+
+@dataclass
+class OopsFormat:
+    regex: "re.Pattern[bytes]"
+    # python % template with positional groups: "KASAN: {0} {2} in {1}"
+    template: str
+
+
+@dataclass
+class Oops:
+    anchor: bytes
+    formats: list[OopsFormat]
+    suppressions: list["re.Pattern[bytes]"] = field(default_factory=list)
+
+
+OOPSES: list[Oops] = [
+    Oops(b"BUG:", [
+        OopsFormat(_compile(r"BUG: KASAN: ([a-z\-]+) in {{FUNC}}(?:.*\n)+?.*(Read|Write) of size ([0-9]+)"),
+                   "KASAN: {0} {2} in {1}"),
+        OopsFormat(_compile(r"BUG: KASAN: ([a-z\-]+) on address(?:.*\n)+?.*(Read|Write) of size ([0-9]+)"),
+                   "KASAN: {0} {1} of size {2}"),
+        OopsFormat(_compile(r"BUG: KASAN: ([a-z\-]+) in ([a-zA-Z0-9_]+)"),
+                   "KASAN: {0} in {1}"),
+        OopsFormat(_compile(r"BUG: KMSAN: ([a-z\-]+) in ([a-zA-Z0-9_]+)"),
+                   "KMSAN: {0} in {1}"),
+        OopsFormat(_compile(r"BUG: KCSAN: ([a-z\-]+) in ([a-zA-Z0-9_]+)"),
+                   "KCSAN: {0} in {1}"),
+        OopsFormat(_compile(r"BUG: unable to handle kernel paging request(?:.*\n)+?.*IP: {{PC}} +{{FUNC}}"),
+                   "BUG: unable to handle kernel paging request in {0}"),
+        OopsFormat(_compile(r"BUG: unable to handle kernel paging request"),
+                   "BUG: unable to handle kernel paging request"),
+        OopsFormat(_compile(r"BUG: unable to handle kernel NULL pointer dereference(?:.*\n)+?.*IP: {{PC}} +{{FUNC}}"),
+                   "BUG: unable to handle kernel NULL pointer dereference in {0}"),
+        OopsFormat(_compile(r"BUG: spinlock lockup suspected"), "BUG: spinlock lockup suspected"),
+        OopsFormat(_compile(r"BUG: spinlock recursion"), "BUG: spinlock recursion"),
+        OopsFormat(_compile(r"BUG: soft lockup"), "BUG: soft lockup"),
+        OopsFormat(_compile(r"BUG: .*still has locks held!(?:.*\n)+?.*{{PC}} +{{FUNC}}"),
+                   "BUG: still has locks held in {0}"),
+        OopsFormat(_compile(r"BUG: Bad rss-counter state"), "BUG: Bad rss-counter state"),
+        OopsFormat(_compile(r"BUG: non-zero nr_ptes on freeing mm"), "BUG: non-zero nr_ptes on freeing mm"),
+        OopsFormat(_compile(r"BUG: non-zero nr_pmds on freeing mm"), "BUG: non-zero nr_pmds on freeing mm"),
+        OopsFormat(_compile(r"BUG: workqueue lockup"), "BUG: workqueue lockup"),
+    ]),
+    Oops(b"WARNING:", [
+        OopsFormat(_compile(r"WARNING: .* at {{SRC}} {{FUNC}}"), "WARNING in {1}"),
+        OopsFormat(_compile(r"WARNING: possible circular locking dependency detected"),
+                   "possible deadlock"),
+        OopsFormat(_compile(r"WARNING: possible recursive locking detected"),
+                   "possible recursive locking"),
+    ], [
+        re.compile(rb"WARNING: /etc/ssh/moduli does not exist, using fixed modulus"),
+    ]),
+    Oops(b"INFO:", [
+        OopsFormat(_compile(r"INFO: possible circular locking dependency detected \](?:.*\n)+?.*is trying to acquire lock(?:.*\n)+?.*at: {{PC}} +{{FUNC}}"),
+                   "possible deadlock in {0}"),
+        OopsFormat(_compile(r"INFO: rcu_preempt detected stalls"), "INFO: rcu detected stall"),
+        OopsFormat(_compile(r"INFO: rcu_sched detected stalls"), "INFO: rcu detected stall"),
+        OopsFormat(_compile(r"INFO: rcu_preempt self-detected stall on CPU"), "INFO: rcu detected stall"),
+        OopsFormat(_compile(r"INFO: rcu_sched self-detected stall on CPU"), "INFO: rcu detected stall"),
+        OopsFormat(_compile(r"INFO: suspicious RCU usage(?:.*\n)+?.*?{{SRC}}"),
+                   "suspicious RCU usage at {0}"),
+        OopsFormat(_compile(r"INFO: task .* blocked for more than [0-9]+ seconds"),
+                   "INFO: task hung"),
+    ], [
+        re.compile(rb"INFO: lockdep is turned off"),
+        re.compile(rb"INFO: Stall ended before state dump start"),
+    ]),
+    Oops(b"Unable to handle kernel paging request", [
+        OopsFormat(_compile(r"Unable to handle kernel paging request(?:.*\n)+?.*PC is at {{FUNC}}"),
+                   "unable to handle kernel paging request in {0}"),
+    ]),
+    Oops(b"general protection fault:", [
+        OopsFormat(_compile(r"general protection fault:(?:.*\n)+?.*RIP: [0-9]+:{{PC}} +{{PC}} +{{FUNC}}"),
+                   "general protection fault in {0}"),
+        OopsFormat(_compile(r"general protection fault:(?:.*\n)+?.*RIP: [0-9]+:([a-zA-Z0-9_]+)\+"),
+                   "general protection fault in {0}"),
+    ]),
+    Oops(b"Kernel panic", [
+        OopsFormat(_compile(r"Kernel panic - not syncing: Attempted to kill init!"),
+                   "kernel panic: Attempted to kill init!"),
+        OopsFormat(_compile(r"Kernel panic - not syncing: (.*)"), "kernel panic: {0}"),
+    ]),
+    Oops(b"kernel BUG", [
+        OopsFormat(_compile(r"kernel BUG (.*)"), "kernel BUG {0}"),
+    ]),
+    Oops(b"Kernel BUG", [
+        OopsFormat(_compile(r"Kernel BUG (.*)"), "kernel BUG {0}"),
+    ]),
+    Oops(b"divide error:", [
+        OopsFormat(_compile(r"divide error: (?:.*\n)+?.*RIP: [0-9]+:{{PC}} +{{PC}} +{{FUNC}}"),
+                   "divide error in {0}"),
+    ]),
+    Oops(b"invalid opcode:", [
+        OopsFormat(_compile(r"invalid opcode: (?:.*\n)+?.*RIP: [0-9]+:{{PC}} +{{PC}} +{{FUNC}}"),
+                   "invalid opcode in {0}"),
+    ]),
+    Oops(b"unreferenced object", [
+        OopsFormat(_compile(r"unreferenced object {{ADDR}} \(size ([0-9]+)\):(?:.*\n.*)+backtrace:.*\n.*{{PC}}.*\n.*{{PC}}.*\n.*{{PC}} {{FUNC}}"),
+                   "memory leak in {1} (size {0})"),
+    ]),
+    Oops(b"UBSAN:", [
+        OopsFormat(_compile(r"UBSAN: (.*)"), "UBSAN: {0}"),
+    ]),
+]
+
+CONSOLE_OUTPUT_RE = re.compile(rb"^\[ *[0-9]+\.[0-9]+\] ")
+QUESTIONABLE_RE = re.compile(rb"(?:\[\<[0-9a-f]+\>\])? \? +[a-zA-Z0-9_.]+\+0x[0-9a-f]+/[0-9a-f]+")
+
+
+@dataclass
+class Report:
+    description: str
+    text: bytes       # the oops region of the log
+    start: int        # byte offset of the oops in the input
+    end: int
+    corrupted: bool = False
+
+
+def contains_crash(output: bytes,
+                   ignores: "list[re.Pattern[bytes]] | None" = None) -> bool:
+    return _find_oops(output, ignores) is not None
+
+
+def _suppressed(oops: Oops, line: bytes,
+                ignores: "list[re.Pattern[bytes]] | None") -> bool:
+    for sup in oops.suppressions:
+        if sup.search(line):
+            return True
+    for ign in ignores or []:
+        if ign.search(line):
+            return True
+    return False
+
+
+def _find_oops(output: bytes, ignores) -> "tuple[Oops, int] | None":
+    pos = 0
+    n = len(output)
+    while pos < n:
+        nl = output.find(b"\n", pos)
+        end = n if nl == -1 else nl
+        line = output[pos:end]
+        for oops in OOPSES:
+            i = line.find(oops.anchor)
+            if i != -1 and not _suppressed(oops, line, ignores):
+                return oops, pos + i
+        pos = end + 1
+    return None
+
+
+def parse(output: bytes,
+          ignores: "list[re.Pattern[bytes]] | None" = None) -> "Report | None":
+    found = _find_oops(output, ignores)
+    if found is None:
+        return None
+    oops, start = found
+    # the report text: from the oops line to the end (or the next prompt),
+    # capped (ref vm.MonitorExecution keeps a 256KB context window)
+    region = output[start:start + (256 << 10)]
+    desc = _extract_description(oops, region)
+    line_end = region.find(b"\n")
+    first_line = region if line_end == -1 else region[:line_end]
+    if not desc:
+        desc = first_line.decode(errors="replace")[:120]
+    return Report(description=desc, text=region, start=start,
+                  end=min(len(output), start + len(region)))
+
+
+def _extract_description(oops: Oops, region: bytes) -> str:
+    for fmt in oops.formats:
+        m = fmt.regex.search(region)
+        if m is None:
+            continue
+        groups = [g.decode(errors="replace") if g is not None else ""
+                  for g in m.groups()]
+        try:
+            return fmt.template.format(*groups)
+        except IndexError:
+            continue
+    return ""
+
+
+def strip_console_prefix(line: bytes) -> bytes:
+    return CONSOLE_OUTPUT_RE.sub(b"", line)

@@ -1,0 +1,147 @@
+"""Symbolization: persistent addr2line/nm subprocess pools.
+
+Capability parity with reference symbolizer/symbolizer.go:37-62 (one
+long-lived `addr2line -afi` process per binary, queried line-by-line)
+and symbolizer/nm.go:19 (`nm -nS` symbol table parsing), plus the
+report-line rewriter from report/report.go:361-449 (Symbolize): frames
+like `[<addr>] func+0xoff/0xsize` gain ` src/file.c:123` suffixes.
+"""
+
+from __future__ import annotations
+
+import re
+import subprocess
+from dataclasses import dataclass
+
+
+@dataclass
+class Symbol:
+    name: str
+    addr: int
+    size: int
+
+
+@dataclass
+class Frame:
+    func: str
+    file: str
+    line: int
+    inline: bool
+
+
+class Symbolizer:
+    """Persistent `addr2line -afi` per vmlinux (spawn once, query many)."""
+
+    def __init__(self, binary: str):
+        self.binary = binary
+        self._proc: "subprocess.Popen | None" = None
+
+    def _ensure(self) -> subprocess.Popen:
+        if self._proc is None or self._proc.poll() is not None:
+            self._proc = subprocess.Popen(
+                ["addr2line", "-afi", "-e", self.binary],
+                stdin=subprocess.PIPE, stdout=subprocess.PIPE,
+                stderr=subprocess.DEVNULL, text=True)
+        return self._proc
+
+    def symbolize(self, addr: int) -> list[Frame]:
+        p = self._ensure()
+        assert p.stdin and p.stdout
+        # A sentinel bad address delimits the (variable-length, due to
+        # inlining) answer for our address.
+        p.stdin.write(f"0x{addr:x}\n0xffffffffffffffff\n")
+        p.stdin.flush()
+        frames: list[Frame] = []
+        # first line echoes the address
+        p.stdout.readline()
+        pending: list[tuple[str, str]] = []
+        while True:
+            func = p.stdout.readline().strip()
+            if func.startswith("0xffffffffffffffff"):
+                p.stdout.readline()  # its ?? line
+                p.stdout.readline()
+                break
+            loc = p.stdout.readline().strip()
+            if not func:
+                break
+            pending.append((func, loc))
+        for i, (func, loc) in enumerate(pending):
+            file, _, line_s = loc.partition(":")
+            try:
+                line = int(line_s.split(" ")[0])
+            except ValueError:
+                line = 0
+            frames.append(Frame(func=func, file=file, line=line,
+                                inline=i < len(pending) - 1))
+        return frames
+
+    def close(self) -> None:
+        if self._proc is not None:
+            self._proc.kill()
+            self._proc.wait()
+            self._proc = None
+
+
+def parse_nm(binary: str) -> dict[str, list[Symbol]]:
+    """Symbol table via `nm -nS` (ref nm.go:19): name -> symbols (dups
+    possible for static functions)."""
+    out = subprocess.run(["nm", "-nS", binary], capture_output=True,
+                         text=True, check=True).stdout
+    syms: dict[str, list[Symbol]] = {}
+    for line in out.splitlines():
+        parts = line.split()
+        if len(parts) != 4:
+            continue
+        addr_s, size_s, typ, name = parts
+        if typ.lower() not in ("t", "w"):
+            continue
+        try:
+            sym = Symbol(name=name, addr=int(addr_s, 16), size=int(size_s, 16))
+        except ValueError:
+            continue
+        syms.setdefault(name, []).append(sym)
+    return syms
+
+
+_SYMBOLIZE_RE = re.compile(
+    rb"(?:\[\<(?:[0-9a-f]+)\>\])? +(?:[0-9]+:)?"
+    rb"([a-zA-Z0-9_.]+)\+0x([0-9a-f]+)/0x([0-9a-f]+)")
+
+
+def symbolize_report(text: bytes, vmlinux: str) -> bytes:
+    """Append file:line to stack-trace frames (ref Symbolize
+    report.go:361-449). Unresolvable frames pass through unchanged."""
+    try:
+        symbols = parse_nm(vmlinux)
+    except (OSError, subprocess.CalledProcessError):
+        return text
+    sym = Symbolizer(vmlinux)
+    strip = vmlinux.rsplit("/", 2)[0] + "/" if "/" in vmlinux else ""
+    out: list[bytes] = []
+    try:
+        for line in text.splitlines(keepends=True):
+            m = _SYMBOLIZE_RE.search(line)
+            if m is None:
+                out.append(line)
+                continue
+            name = m.group(1).decode()
+            off = int(m.group(2), 16)
+            size = int(m.group(3), 16)
+            cands = [s for s in symbols.get(name, []) if s.size == size]
+            if len(cands) != 1:
+                out.append(line)
+                continue
+            frames = sym.symbolize(cands[0].addr + off - 1)
+            if not frames:
+                out.append(line)
+                continue
+            f = frames[-1]
+            file = f.file
+            if strip and file.startswith(strip):
+                file = file[len(strip):]
+            suffix = f" {file}:{f.line}".encode()
+            nl = b"\n" if line.endswith(b"\n") else b""
+            out.append(line.rstrip(b"\n") + suffix + nl)
+    finally:
+        sym.close()
+    return b"".join(out)

@@ -1,0 +1,150 @@
+"""RPC plane: manager ↔ fuzzer (and manager ↔ hub) wire protocol.
+
+Capability parity with the reference's net/rpc JSON codec over TCP
+(syz-manager/manager.go:163-182, syz-fuzzer/fuzzer.go:116-120) and the
+rpctype message shapes (rpctype/rpctype.go:8-63): Connect, Check, Poll,
+NewInput, Hub.Connect, Hub.Sync. The wire format is length-free
+JSON-lines: one request/response object per line.
+
+    request:  {"id": N, "method": "Manager.Connect", "params": {...}}
+    response: {"id": N, "result": {...}} | {"id": N, "error": "..."}
+
+Binary payloads (serialized programs, coverage arrays) ride as base64 /
+integer lists inside params — same spirit as the reference's JSON codec.
+"""
+
+from __future__ import annotations
+
+import base64
+import json
+import socket
+import socketserver
+import threading
+from typing import Any, Callable
+
+
+def b64(data: bytes) -> str:
+    return base64.b64encode(data).decode()
+
+
+def unb64(s: str) -> bytes:
+    return base64.b64decode(s)
+
+
+class RpcError(Exception):
+    pass
+
+
+class RpcServer:
+    """Threaded JSON-lines RPC server. Handlers: dict method -> fn(params)
+    -> result dict. One thread per connection (keep-alive, many calls)."""
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 0):
+        self.handlers: dict[str, Callable[[dict], dict]] = {}
+        outer = self
+
+        class Handler(socketserver.StreamRequestHandler):
+            def handle(self):
+                for line in self.rfile:
+                    line = line.strip()
+                    if not line:
+                        continue
+                    try:
+                        req = json.loads(line)
+                        method = req.get("method", "")
+                        fn = outer.handlers.get(method)
+                        if fn is None:
+                            resp = {"id": req.get("id"),
+                                    "error": f"unknown method {method}"}
+                        else:
+                            resp = {"id": req.get("id"),
+                                    "result": fn(req.get("params") or {})}
+                    except Exception as e:  # handler bug -> error reply
+                        resp = {"id": req.get("id") if isinstance(req, dict) else None,
+                                "error": f"{type(e).__name__}: {e}"}
+                    try:
+                        self.wfile.write(json.dumps(resp).encode() + b"\n")
+                        self.wfile.flush()
+                    except (BrokenPipeError, ConnectionResetError):
+                        return
+
+        class Server(socketserver.ThreadingTCPServer):
+            daemon_threads = True
+            allow_reuse_address = True
+
+        self._server = Server((host, port), Handler)
+        self.addr = self._server.server_address
+        self._thread: "threading.Thread | None" = None
+
+    def register(self, method: str, fn: Callable[[dict], dict]) -> None:
+        self.handlers[method] = fn
+
+    def serve_background(self) -> None:
+        self._thread = threading.Thread(target=self._server.serve_forever,
+                                        daemon=True)
+        self._thread.start()
+
+    def close(self) -> None:
+        # shutdown() blocks forever unless serve_forever is running
+        if self._thread is not None:
+            self._server.shutdown()
+        self._server.server_close()
+
+
+class RpcClient:
+    """Blocking JSON-lines RPC client with keep-alive reconnect."""
+
+    def __init__(self, addr: "tuple[str, int] | str", timeout: float = 60.0):
+        if isinstance(addr, str):
+            host, _, port = addr.rpartition(":")
+            addr = (host or "127.0.0.1", int(port))
+        self.addr = addr
+        self.timeout = timeout
+        self._sock: "socket.socket | None" = None
+        self._file = None
+        self._id = 0
+        self._mu = threading.Lock()
+
+    def _connect(self):
+        s = socket.create_connection(self.addr, timeout=self.timeout)
+        s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        s.setsockopt(socket.SOL_SOCKET, socket.SO_KEEPALIVE, 1)
+        self._sock = s
+        self._file = s.makefile("rwb")
+
+    def call(self, method: str, params: "dict | None" = None) -> dict:
+        with self._mu:
+            for attempt in (0, 1):
+                if self._sock is None:
+                    self._connect()
+                try:
+                    self._id += 1
+                    req = {"id": self._id, "method": method,
+                           "params": params or {}}
+                    self._file.write(json.dumps(req).encode() + b"\n")
+                    self._file.flush()
+                    line = self._file.readline()
+                    if not line:
+                        raise ConnectionError("server closed connection")
+                    resp = json.loads(line)
+                    if resp.get("error"):
+                        raise RpcError(resp["error"])
+                    return resp.get("result") or {}
+                except (OSError, ConnectionError, json.JSONDecodeError):
+                    self.close_socket()
+                    if attempt == 1:
+                        raise
+            raise RpcError("unreachable")
+
+    def close_socket(self) -> None:
+        if self._sock is not None:
+            try:
+                self._sock.close()
+            except OSError:
+                pass
+            self._sock = None
+            self._file = None
+
+    def close(self) -> None:
+        with self._mu:
+            self.close_socket()

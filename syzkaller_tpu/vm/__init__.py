@@ -1,0 +1,8 @@
+"""Machine layer: Instance plugin registry + adapters + monitor."""
+
+from syzkaller_tpu.vm.base import (  # noqa: F401
+    Instance, OutputMerger, RunHandle, create, register, types,
+)
+from syzkaller_tpu.vm.monitor import Outcome, monitor_execution  # noqa: F401
+from syzkaller_tpu.vm import local  # noqa: F401  (registers "local")
+from syzkaller_tpu.vm import qemu  # noqa: F401   (registers "qemu")

@@ -1,0 +1,106 @@
+"""VM abstraction: Instance interface + plugin registry.
+
+Capability parity with reference vm/vm.go:20-75: the Instance seam
+{Copy, Forward, Run, Close} behind a constructor registry, so schedulers
+(qemu/local/adb/gce — and the BASELINE's 'tpu' type) plug in without
+touching the manager.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+from abc import ABC, abstractmethod
+from dataclasses import dataclass, field
+from typing import Callable
+
+_registry: dict[str, Callable[..., "Instance"]] = {}
+
+
+def register(typ: str, ctor: Callable[..., "Instance"]) -> None:
+    _registry[typ] = ctor
+
+
+def create(typ: str, cfg, index: int) -> "Instance":
+    ctor = _registry.get(typ)
+    if ctor is None:
+        raise ValueError(f"unknown VM type {typ!r} (known: {sorted(_registry)})")
+    return ctor(cfg, index)
+
+
+def types() -> list[str]:
+    return sorted(_registry)
+
+
+@dataclass
+class RunHandle:
+    """A running guest command: a merged output stream + liveness.
+    Output chunks (bytes) arrive on `output`; EOF/errors push a sentinel
+    (None = clean EOF, Exception = error)."""
+
+    output: "queue.Queue[bytes | None | Exception]"
+    stop: Callable[[], None]       # terminate the command
+    is_alive: Callable[[], bool]
+
+
+class Instance(ABC):
+    """One test machine (ref vm/vm.go:20-36)."""
+
+    index: int = 0
+
+    @abstractmethod
+    def copy(self, host_path: str) -> str:
+        """Copy a file into the machine; returns the guest path."""
+
+    @abstractmethod
+    def forward(self, port: int) -> str:
+        """Expose a manager-side TCP port to the guest; returns the
+        address the guest should dial."""
+
+    @abstractmethod
+    def run(self, command: str, timeout: float) -> RunHandle:
+        """Run a command in the machine, console+ssh output merged."""
+
+    @abstractmethod
+    def close(self) -> None:
+        ...
+
+
+class OutputMerger:
+    """Multiplex several byte streams into one queue, tee'd to an
+    optional file (ref vm/merger.go:13-76)."""
+
+    def __init__(self, tee_path: "str | None" = None):
+        self.output: "queue.Queue[bytes | None | Exception]" = queue.Queue()
+        self._active = 0
+        self._mu = threading.Lock()
+        self._tee = open(tee_path, "ab") if tee_path else None
+
+    def add(self, name: str, stream) -> None:
+        """stream: a file-like object with .read1/.readline returning bytes."""
+        with self._mu:
+            self._active += 1
+        t = threading.Thread(target=self._pump, args=(name, stream), daemon=True)
+        t.start()
+
+    def _pump(self, name: str, stream) -> None:
+        try:
+            while True:
+                chunk = stream.readline()
+                if not chunk:
+                    break
+                if self._tee:
+                    self._tee.write(chunk)
+                    self._tee.flush()
+                self.output.put(chunk)
+        except (OSError, ValueError):
+            pass
+        finally:
+            with self._mu:
+                self._active -= 1
+                if self._active == 0:
+                    self.output.put(None)
+            try:
+                stream.close()
+            except OSError:
+                pass

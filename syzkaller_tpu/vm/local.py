@@ -1,0 +1,69 @@
+"""Local machine adapter: run the fuzzer on the host, no VM.
+
+Capability parity with reference vm/local/local.go:151 — the CI /
+development adapter. Crashes of the host kernel obviously aren't
+recoverable, so this type is for pipeline testing and non-kernel
+targets; it is also the seam the driver's hermetic manager test uses.
+"""
+
+from __future__ import annotations
+
+import os
+import shutil
+import signal
+import subprocess
+
+from syzkaller_tpu.vm import base
+
+
+class LocalInstance(base.Instance):
+    def __init__(self, cfg, index: int):
+        self.index = index
+        self.workdir = os.path.join(cfg.workdir, f"local-{index}")
+        os.makedirs(self.workdir, exist_ok=True)
+        self._procs: list[subprocess.Popen] = []
+
+    def copy(self, host_path: str) -> str:
+        dst = os.path.join(self.workdir, os.path.basename(host_path))
+        if os.path.abspath(host_path) != os.path.abspath(dst):
+            shutil.copy2(host_path, dst)
+            os.chmod(dst, 0o755)
+        return dst
+
+    def forward(self, port: int) -> str:
+        return f"127.0.0.1:{port}"
+
+    def run(self, command: str, timeout: float) -> base.RunHandle:
+        merger = base.OutputMerger()
+        proc = subprocess.Popen(
+            command, shell=True, cwd=self.workdir,
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+            start_new_session=True)
+        self._procs.append(proc)
+        merger.add("local", proc.stdout)
+
+        def stop():
+            try:
+                os.killpg(proc.pid, signal.SIGKILL)
+            except (ProcessLookupError, PermissionError):
+                proc.kill()
+
+        return base.RunHandle(output=merger.output, stop=stop,
+                              is_alive=lambda: proc.poll() is None)
+
+    def close(self) -> None:
+        for p in self._procs:
+            try:
+                os.killpg(p.pid, signal.SIGKILL)
+            except (ProcessLookupError, PermissionError):
+                try:
+                    p.kill()
+                except ProcessLookupError:
+                    pass
+            try:
+                p.wait(timeout=5)
+            except subprocess.TimeoutExpired:
+                pass
+
+
+base.register("local", LocalInstance)

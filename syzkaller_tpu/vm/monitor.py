@@ -1,0 +1,96 @@
+"""Execution monitoring: classify a machine's console stream.
+
+Capability parity with reference vm/vm.go:90-191 (MonitorExecution):
+streaming oops scan via the report package over a bounded context
+window, "no output" and overall timeouts, lost-connection and
+"not executing programs" classification, and the preemption marker.
+"""
+
+from __future__ import annotations
+
+import queue
+import time
+from dataclasses import dataclass
+
+from syzkaller_tpu import report as report_pkg
+from syzkaller_tpu.vm.base import RunHandle
+
+NO_OUTPUT_TIMEOUT = 3 * 60.0      # ref vm.go: 3-min liveness
+WAIT_FOR_REPORT = 5.0             # collect the full oops after detection
+CONTEXT_WINDOW = 256 << 10        # ref vm.go 256KB window
+EXECUTING_MARKER = b"executing program"
+PREEMPTED_MARKER = b"PREEMPTED"
+
+
+@dataclass
+class Outcome:
+    title: str                       # crash description or timeout class
+    report: "report_pkg.Report | None"
+    output: bytes                    # full captured output
+    crashed: bool
+    timed_out: bool = False
+
+
+def monitor_execution(handle: RunHandle, timeout: float,
+                      ignores=None, need_executing: bool = True) -> Outcome:
+    """Consume the run's output until crash/timeout/EOF (ref vm.go:90)."""
+    buf = bytearray()
+    window_start = 0
+    deadline = time.time() + timeout
+    last_output = time.time()
+    saw_executing = not need_executing
+    crashed_report: "report_pkg.Report | None" = None
+    crash_deadline = None
+
+    def window() -> bytes:
+        return bytes(buf[window_start:])
+
+    while True:
+        now = time.time()
+        if crash_deadline is not None and now >= crash_deadline:
+            break
+        if now >= deadline:
+            # the normal outcome of a long run (ref manager.go:376-385)
+            return Outcome(title="timed out", report=None, output=bytes(buf),
+                           crashed=False, timed_out=True)
+        if now - last_output > NO_OUTPUT_TIMEOUT:
+            return Outcome(title="no output from test machine",
+                           report=None, output=bytes(buf), crashed=True)
+        try:
+            chunk = handle.output.get(timeout=0.5)
+        except queue.Empty:
+            continue
+        if chunk is None or isinstance(chunk, Exception):
+            # stream closed: connection lost or clean exit
+            if crashed_report is not None:
+                break
+            rep = report_pkg.parse(window(), ignores)
+            if rep is not None:
+                return _crash_outcome(rep, buf, window_start)
+            title = ("lost connection to test machine"
+                     if isinstance(chunk, Exception) else
+                     ("no output from test machine" if not saw_executing
+                      else "lost connection to test machine"))
+            return Outcome(title=title, report=None, output=bytes(buf),
+                           crashed=True)
+        last_output = time.time()
+        buf.extend(chunk)
+        if EXECUTING_MARKER in chunk:
+            saw_executing = True
+        if PREEMPTED_MARKER in chunk:
+            return Outcome(title="preempted", report=None, output=bytes(buf),
+                           crashed=False, timed_out=True)
+        if len(buf) - window_start > CONTEXT_WINDOW:
+            window_start = len(buf) - CONTEXT_WINDOW // 2
+        if crashed_report is None and report_pkg.contains_crash(chunk, ignores):
+            # grab the full report: keep reading a little while
+            crash_deadline = time.time() + WAIT_FOR_REPORT
+            crashed_report = report_pkg.parse(window(), ignores)
+    rep = report_pkg.parse(window(), ignores) or crashed_report
+    assert rep is not None
+    return _crash_outcome(rep, buf, window_start)
+
+
+def _crash_outcome(rep, buf: bytearray, window_start: int) -> Outcome:
+    return Outcome(title=rep.description, report=rep, output=bytes(buf),
+                   crashed=True)

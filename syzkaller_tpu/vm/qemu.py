@@ -1,0 +1,154 @@
+"""QEMU adapter: boot kernels under qemu-system, drive them over ssh.
+
+Capability parity with reference vm/qemu/qemu.go:41-180: boot with
+kernel+initrd or disk image, user-mode networking with ssh port
+forwarding, serial console piped into the output merger, scp-based file
+copy, and hostfwd-based manager-port forwarding.
+"""
+
+from __future__ import annotations
+
+import os
+import socket
+import subprocess
+import time
+
+from syzkaller_tpu.utils import log
+from syzkaller_tpu.vm import base
+
+
+def _free_port() -> int:
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+class QemuInstance(base.Instance):
+    SSH_OPTS = ["-o", "StrictHostKeyChecking=no",
+                "-o", "UserKnownHostsFile=/dev/null",
+                "-o", "BatchMode=yes", "-o", "IdentitiesOnly=yes",
+                "-o", "ConnectTimeout=10"]
+
+    def __init__(self, cfg, index: int):
+        self.cfg = cfg
+        self.index = index
+        self.workdir = os.path.join(cfg.workdir, f"qemu-{index}")
+        os.makedirs(self.workdir, exist_ok=True)
+        self.ssh_port = _free_port()
+        self._fwd: dict[int, int] = {}  # manager port -> guest-visible port
+        self._qemu: "subprocess.Popen | None" = None
+        self._merger = base.OutputMerger(
+            tee_path=os.path.join(self.workdir, "console.log"))
+        self._boot()
+
+    def _boot(self) -> None:
+        c = self.cfg
+        bin_ = getattr(c, "qemu", "") or "qemu-system-x86_64"
+        args = [bin_,
+                "-m", str(getattr(c, "mem", 1024)),
+                "-smp", str(getattr(c, "cpu", 1)),
+                "-display", "none", "-serial", "stdio", "-no-reboot",
+                "-device", "virtio-rng-pci",
+                "-enable-kvm" if os.path.exists("/dev/kvm") else "-accel",
+                ]
+        if not os.path.exists("/dev/kvm"):
+            args.append("tcg")
+        net = (f"user,id=net0,restrict=on,"
+               f"hostfwd=tcp:127.0.0.1:{self.ssh_port}-:22")
+        args += ["-netdev", net, "-device", "virtio-net-pci,netdev=net0"]
+        kernel = getattr(c, "kernel", "")
+        image = getattr(c, "image", "")
+        if kernel:
+            args += ["-kernel", kernel, "-append",
+                     getattr(c, "cmdline",
+                             "console=ttyS0 root=/dev/sda rw")]
+        if image:
+            if getattr(c, "image_9p", False):
+                args += ["-fsdev",
+                         f"local,id=fsdev0,path={image},security_model=none",
+                         "-device",
+                         "virtio-9p-pci,fsdev=fsdev0,mount_tag=/dev/root"]
+            else:
+                args += ["-drive", f"file={image},format=raw,if=ide"]
+        if getattr(c, "initrd", ""):
+            args += ["-initrd", c.initrd]
+        log.logf(1, "qemu-%d: %s", self.index, " ".join(args))
+        self._qemu = subprocess.Popen(
+            args, cwd=self.workdir,
+            stdin=subprocess.DEVNULL, stdout=subprocess.PIPE,
+            stderr=subprocess.STDOUT, start_new_session=True)
+        self._merger.add("console", self._qemu.stdout)
+        self._wait_ssh(getattr(self.cfg, "boot_timeout", 10 * 60.0))
+
+    def _ssh_base(self) -> list[str]:
+        key = getattr(self.cfg, "sshkey", "")
+        opts = list(self.SSH_OPTS)
+        if key:
+            opts += ["-i", key]
+        return opts + ["-p", str(self.ssh_port)]
+
+    def _wait_ssh(self, timeout: float) -> None:
+        deadline = time.time() + timeout
+        while time.time() < deadline:
+            if self._qemu.poll() is not None:
+                raise RuntimeError(f"qemu-{self.index} exited during boot")
+            r = subprocess.run(
+                ["ssh", *self._ssh_base(), "root@127.0.0.1", "true"],
+                capture_output=True, timeout=30)
+            if r.returncode == 0:
+                return
+            time.sleep(5)
+        raise TimeoutError(f"qemu-{self.index}: ssh did not come up")
+
+    def copy(self, host_path: str) -> str:
+        dst = "/" + os.path.basename(host_path)
+        subprocess.run(
+            ["scp", *self._ssh_base(), "-P", str(self.ssh_port),
+             host_path, f"root@127.0.0.1:{dst}"],
+            check=True, capture_output=True, timeout=300)
+        return dst
+
+    def forward(self, port: int) -> str:
+        # remote port forward: guest's localhost:port -> host port
+        remote = self._fwd.get(port)
+        if remote is None:
+            remote = port
+            subprocess.Popen(
+                ["ssh", *self._ssh_base(), "-N",
+                 "-R", f"{remote}:127.0.0.1:{port}", "root@127.0.0.1"],
+                stdin=subprocess.DEVNULL, stdout=subprocess.DEVNULL,
+                stderr=subprocess.DEVNULL, start_new_session=True)
+            self._fwd[port] = remote
+        return f"127.0.0.1:{remote}"
+
+    def run(self, command: str, timeout: float) -> base.RunHandle:
+        proc = subprocess.Popen(
+            ["ssh", *self._ssh_base(), "root@127.0.0.1", command],
+            stdin=subprocess.DEVNULL, stdout=subprocess.PIPE,
+            stderr=subprocess.STDOUT, start_new_session=True)
+        self._merger.add("ssh", proc.stdout)
+
+        def stop():
+            try:
+                proc.kill()
+            except ProcessLookupError:
+                pass
+
+        alive = (lambda: proc.poll() is None and
+                 self._qemu is not None and self._qemu.poll() is None)
+        return base.RunHandle(output=self._merger.output, stop=stop,
+                              is_alive=alive)
+
+    def close(self) -> None:
+        if self._qemu is not None:
+            try:
+                os.killpg(self._qemu.pid, 9)
+            except (ProcessLookupError, PermissionError):
+                self._qemu.kill()
+            self._qemu.wait()
+            self._qemu = None
+
+
+base.register("qemu", QemuInstance)

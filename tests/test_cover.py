@@ -219,3 +219,31 @@ def test_merge_corpus_full_does_not_merge_cover(rng):
     # coverage must remain admittable: triage still reports new signal
     has_new, _, _ = eng.triage_diff(calls, idx, valid)
     assert has_new.all()
+
+
+def test_compact_corpus(rng):
+    """Minimize must actually free device admission capacity."""
+    eng = CoverageEngine(npcs=1024, ncalls=4, corpus_cap=4)
+    big = np.arange(0, 100, dtype=np.uint32)
+    small = np.arange(0, 50, dtype=np.uint32)
+    other = np.arange(200, 260, dtype=np.uint32)
+    calls = np.array([0, 0, 1], np.int32)
+    idx, valid = make_batch([small, big, other])
+    _, _, bitmaps = eng.triage_diff(calls, idx, valid)
+    eng.merge_corpus(calls, bitmaps)
+    assert eng.corpus_len == 3
+    keep = eng.minimize_corpus()
+    assert list(keep[:3]) == [False, True, True]  # small subsumed by big
+    mapping = eng.compact_corpus(keep)
+    assert mapping == {1: 0, 2: 1}
+    assert eng.corpus_len == 2
+    assert list(eng.corpus_call[:2]) == [0, 1]
+    # cover rebuilt from survivors: big's PCs still covered for call 0
+    idx2, valid2 = make_batch([big])
+    has_new, _, _ = eng.triage_diff(np.zeros(1, np.int32), idx2, valid2)
+    assert not has_new[0]
+    # and capacity is free again
+    fresh = np.arange(500, 520, dtype=np.uint32)
+    idxf, validf = make_batch([fresh, fresh])
+    _, _, bm = eng.triage_diff(np.array([2, 3], np.int32), idxf, validf)
+    assert eng.merge_corpus(np.array([2, 3], np.int32), bm) is not None

@@ -1,0 +1,218 @@
+"""Manager-tier tests: config, persistence, report parsing, monitor
+classification, RPC plane, and a live manager↔fuzzer integration run
+over the local VM adapter (the multi-node plane the reference only
+tests in production — we do it hermetically, SURVEY §4.6)."""
+
+import os
+import queue
+import re
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from syzkaller_tpu import report as report_pkg
+from syzkaller_tpu import rpc
+from syzkaller_tpu.manager import Config, ConfigError, PersistentSet, loads
+from syzkaller_tpu.sys.table import load_table
+from syzkaller_tpu.vm.base import RunHandle
+from syzkaller_tpu.vm.monitor import monitor_execution
+
+# -- config ----------------------------------------------------------------
+
+
+def test_config_unknown_field():
+    with pytest.raises(ConfigError, match="unknown config fields"):
+        loads('{"name": "x", "no_such_field": 1}')
+
+
+def test_config_validation():
+    with pytest.raises(ConfigError, match="count"):
+        loads('{"count": 0}')
+    with pytest.raises(ConfigError, match="procs"):
+        loads('{"procs": 64}')
+    with pytest.raises(ConfigError, match="VM type"):
+        loads('{"type": "warp-drive"}')
+    with pytest.raises(ConfigError, match="qemu requires"):
+        loads('{"type": "qemu"}')
+
+
+def test_config_syscall_globs():
+    table = load_table(files=["probe.txt"])
+    cfg = Config(enable_syscalls=["syz_probe$res_*", "mmap"],
+                 disable_syscalls=["syz_probe$res_leaf"])
+    names = cfg.enabled_calls(table)
+    assert "mmap" in names
+    assert "syz_probe$res_new" in names
+    assert "syz_probe$res_leaf" not in names
+    assert "syz_probe$ints" not in names
+    with pytest.raises(ConfigError, match="matches nothing"):
+        Config(enable_syscalls=["nope*"]).enabled_calls(table)
+
+
+# -- persistent corpus -----------------------------------------------------
+
+
+def test_persistent_set(tmp_path):
+    d = str(tmp_path / "corpus")
+    ps = PersistentSet(d)
+    assert ps.add(b"prog-a\n") and ps.add(b"prog-b\n")
+    assert not ps.add(b"prog-a\n")  # dedup
+    # reload with verification; also plant a corrupt entry
+    with open(os.path.join(d, "deadbeef"), "wb") as f:
+        f.write(b"junk")
+    ps2 = PersistentSet(d, verify=lambda data: data.startswith(b"prog"))
+    assert len(ps2) == 2
+    assert not os.path.exists(os.path.join(d, "deadbeef"))
+    ps2.minimize([b"prog-a\n"])
+    assert PersistentSet(d).values() == [b"prog-a\n"]
+
+
+# -- report ----------------------------------------------------------------
+
+KASAN_LOG = b"""[  64.01] ==================================
+[  64.01] BUG: KASAN: use-after-free in remove_wait_queue+0xfb/0x120
+[  64.02] Write of size 8 at addr ffff88006c4c chev by task syz-executor/5310
+[  64.03] Call Trace:
+"""
+
+
+def test_report_kasan():
+    assert report_pkg.contains_crash(KASAN_LOG)
+    rep = report_pkg.parse(KASAN_LOG)
+    assert rep.description == "KASAN: use-after-free Write in remove_wait_queue"
+
+
+def test_report_variants():
+    cases = [
+        (b"Kernel panic - not syncing: Attempted to kill init!\n",
+         "kernel panic: Attempted to kill init!"),
+        (b"[ 5.0] INFO: rcu_sched detected stalls on CPUs\n",
+         "INFO: rcu detected stall"),
+        (b"INFO: task syz-executor blocked for more than 120 seconds\n",
+         "INFO: task hung"),
+        (b"BUG: spinlock recursion on CPU#1\n", "BUG: spinlock recursion"),
+        (b"UBSAN: shift-out-of-bounds in foo.c:10\n",
+         "UBSAN: shift-out-of-bounds in foo.c:10"),
+    ]
+    for log_text, desc in cases:
+        rep = report_pkg.parse(log_text)
+        assert rep is not None, log_text
+        assert rep.description == desc
+    assert not report_pkg.contains_crash(b"all fine\nnothing here\n")
+
+
+def test_report_suppressions():
+    line = b"WARNING: /etc/ssh/moduli does not exist, using fixed modulus\n"
+    assert not report_pkg.contains_crash(line)
+    assert report_pkg.contains_crash(
+        b"WARNING: CPU: 0 PID: 1 at kernel/foo.c:10 bar+0x10/0x20\n")
+    ignores = [re.compile(rb"WARNING: CPU")]
+    assert not report_pkg.contains_crash(
+        b"WARNING: CPU: 0 PID: 1 at kernel/foo.c:10 bar+0x1/0x2\n", ignores)
+
+
+# -- monitor ---------------------------------------------------------------
+
+
+def _handle_from_chunks(chunks):
+    q = queue.Queue()
+    for c in chunks:
+        q.put(c)
+    return RunHandle(output=q, stop=lambda: None, is_alive=lambda: True)
+
+
+def test_monitor_detects_crash():
+    h = _handle_from_chunks([
+        b"booting\n", b"executing program 0:\nfoo()\n",
+        KASAN_LOG, b"trailing context\n", None,
+    ])
+    out = monitor_execution(h, timeout=10.0)
+    assert out.crashed
+    assert out.title == "KASAN: use-after-free Write in remove_wait_queue"
+    assert b"trailing context" in out.output
+
+
+def test_monitor_timeout_is_normal():
+    q = queue.Queue()
+    h = RunHandle(output=q, stop=lambda: None, is_alive=lambda: True)
+    out = monitor_execution(h, timeout=1.0)
+    assert out.timed_out and not out.crashed
+
+
+def test_monitor_lost_connection():
+    h = _handle_from_chunks([b"executing program 0:\nfoo()\n", None])
+    out = monitor_execution(h, timeout=10.0)
+    assert out.crashed
+    assert out.title == "lost connection to test machine"
+
+
+def test_monitor_no_output_classification():
+    h = _handle_from_chunks([b"booted, doing nothing\n", None])
+    out = monitor_execution(h, timeout=10.0)
+    assert out.crashed
+    assert out.title == "no output from test machine"
+
+
+# -- rpc -------------------------------------------------------------------
+
+
+def test_rpc_roundtrip():
+    srv = rpc.RpcServer()
+    srv.register("Echo", lambda p: {"got": p})
+    srv.register("Boom", lambda p: 1 / 0)
+    srv.serve_background()
+    try:
+        cli = rpc.RpcClient(srv.addr)
+        assert cli.call("Echo", {"x": [1, 2]}) == {"got": {"x": [1, 2]}}
+        with pytest.raises(rpc.RpcError, match="ZeroDivisionError"):
+            cli.call("Boom")
+        with pytest.raises(rpc.RpcError, match="unknown method"):
+            cli.call("Nope")
+        # concurrent clients
+        def hammer():
+            c = rpc.RpcClient(srv.addr)
+            for i in range(20):
+                assert c.call("Echo", {"i": i})["got"]["i"] == i
+            c.close()
+        ts = [threading.Thread(target=hammer) for _ in range(4)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join()
+        cli.close()
+    finally:
+        srv.close()
+
+
+# -- live integration ------------------------------------------------------
+
+
+@pytest.mark.skipif(os.system("g++ --version > /dev/null 2>&1") != 0,
+                    reason="no g++")
+def test_manager_fuzzer_integration(tmp_path):
+    from syzkaller_tpu.manager.manager import Manager
+
+    cfg = Config(workdir=str(tmp_path / "workdir"), type="local", count=1,
+                 procs=2, descriptions="probe.txt", npcs=1 << 14,
+                 http="", corpus_cap=1 << 12)
+    mgr = Manager(cfg)
+    t = threading.Thread(target=mgr.run, kwargs={"duration": 25.0})
+    t.start()
+    t.join(timeout=60.0)
+    assert not t.is_alive()
+    with mgr._mu:
+        execs = mgr.stats.get("exec total", 0)
+        ncorpus = len(mgr.corpus)
+    assert execs > 20, f"only {execs} execs"
+    assert ncorpus > 0
+    assert len(mgr.persistent) == ncorpus
+    assert mgr.engine.corpus_len >= ncorpus
+
+    # restart on the same workdir: corpus reloads as candidates
+    mgr2 = Manager(Config(workdir=str(tmp_path / "workdir"), type="local",
+                          count=1, procs=1, descriptions="probe.txt",
+                          npcs=1 << 14, http=""))
+    assert len(mgr2.candidates) >= ncorpus  # a few NewInputs can land after the stats snapshot
+    mgr2.server.close()
