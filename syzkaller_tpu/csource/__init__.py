@@ -1,0 +1,5 @@
+"""C reproducer generation."""
+
+from syzkaller_tpu.csource.csource import (  # noqa: F401
+    Options, build, generate,
+)

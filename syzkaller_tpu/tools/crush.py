@@ -1,0 +1,65 @@
+"""Replay a crash log on many machines in parallel, hunting a flaky
+reproducer (ref tools/syz-crush, crush.go:4-6,135).
+
+    python -m syzkaller_tpu.tools.crush -config mgr.cfg crash.log
+"""
+
+from __future__ import annotations
+
+import argparse
+import shlex
+import sys
+import threading
+
+from syzkaller_tpu import vm
+from syzkaller_tpu.manager import config as config_mod
+from syzkaller_tpu.utils import log
+from syzkaller_tpu.vm.monitor import monitor_execution
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser()
+    ap.add_argument("log", help="crash log with programs to replay")
+    ap.add_argument("-config", required=True)
+    ap.add_argument("-restart-time", type=float, default=3600.0)
+    ap.add_argument("-v", type=int, default=0)
+    args = ap.parse_args(argv)
+    log.set_verbosity(args.v)
+    cfg = config_mod.load(args.config)
+    suppressions = cfg.compiled_suppressions()
+
+    def crush_loop(index: int) -> None:
+        while True:
+            inst = None
+            try:
+                inst = vm.create(cfg.type, cfg, index)
+                guest_log = inst.copy(args.log)
+                cmd = [sys.executable, "-m", "syzkaller_tpu.tools.execprog",
+                       "-file", guest_log, "-repeat", "0", "-threaded",
+                       "-collide"]
+                handle = inst.run(" ".join(shlex.quote(c) for c in cmd),
+                                  args.restart_time)
+                outcome = monitor_execution(handle, args.restart_time,
+                                            ignores=suppressions,
+                                            need_executing=False)
+                handle.stop()
+                if outcome.crashed:
+                    log.logf(0, "vm-%d: CRASHED: %s", index, outcome.title)
+                else:
+                    log.logf(0, "vm-%d: %s", index, outcome.title)
+            except Exception as e:
+                log.logf(0, "vm-%d error: %s", index, e)
+            finally:
+                if inst is not None:
+                    inst.close()
+
+    threads = [threading.Thread(target=crush_loop, args=(i,), daemon=True)
+               for i in range(cfg.count)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+
+
+if __name__ == "__main__":
+    main()

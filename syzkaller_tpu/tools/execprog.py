@@ -1,0 +1,97 @@
+"""Replay serialized programs through the executor.
+
+Capability parity with reference tools/syz-execprog (execprog.go:4-5,
+119-138): execute programs from a file (corpus dir or single log),
+optionally repeatedly, printing per-call errno and coverage summaries.
+Used by the repro pipeline inside test machines.
+
+    python -m syzkaller_tpu.tools.execprog -file prog.txt -repeat 3
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import os
+import sys
+
+from syzkaller_tpu import ipc
+from syzkaller_tpu import prog as P
+from syzkaller_tpu.sys.table import load_table
+from syzkaller_tpu.utils import log
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser()
+    ap.add_argument("-file", required=True,
+                    help="program file, corpus dir, or execution log")
+    ap.add_argument("-descriptions", default="all")
+    ap.add_argument("-repeat", type=int, default=1,
+                    help="0 = forever")
+    ap.add_argument("-procs", type=int, default=1)
+    ap.add_argument("-threaded", action="store_true")
+    ap.add_argument("-collide", action="store_true")
+    ap.add_argument("-sandbox", default="none")
+    ap.add_argument("-cover", action="store_true", default=True)
+    ap.add_argument("-real-cover", action="store_true")
+    ap.add_argument("-output", action="store_true",
+                    help="echo each program before executing")
+    ap.add_argument("-v", type=int, default=0)
+    args = ap.parse_args(argv)
+    log.set_verbosity(args.v)
+
+    table = load_table(files=None if args.descriptions in ("all", "linux")
+                       else [args.descriptions])
+    progs: list[bytes] = []
+    if os.path.isdir(args.file):
+        for path in sorted(glob.glob(os.path.join(args.file, "*"))):
+            with open(path, "rb") as f:
+                progs.append(f.read())
+    else:
+        with open(args.file, "rb") as f:
+            data = f.read()
+        entries = P.parse_log(data, table)
+        if entries:
+            progs = [P.serialize(e.prog) for e in entries]
+        else:
+            progs = [data]
+
+    flags = ipc.FLAG_COVER | ipc.FLAG_DEDUP_COVER
+    if not args.real_cover:
+        flags |= ipc.FLAG_FAKE_COVER
+    if args.threaded:
+        flags |= ipc.FLAG_THREADED
+    if args.collide:
+        flags |= ipc.FLAG_COLLIDE
+    if args.sandbox == "setuid":
+        flags |= ipc.FLAG_SANDBOX_SETUID
+    elif args.sandbox == "namespace":
+        flags |= ipc.FLAG_SANDBOX_NAMESPACE
+
+    env = ipc.Env(flags=flags)
+    try:
+        iteration = 0
+        while args.repeat == 0 or iteration < args.repeat:
+            iteration += 1
+            for i, data in enumerate(progs):
+                try:
+                    p = P.deserialize(data, table)
+                except P.DeserializeError as e:
+                    log.logf(0, "prog %d: parse error: %s", i, e)
+                    continue
+                if args.output:
+                    sys.stdout.write(f"executing program {i}:\n"
+                                     f"{data.decode(errors='replace')}\n")
+                    sys.stdout.flush()
+                res = env.exec(p)
+                total_cov = sum(len(c.cover) for c in res.calls)
+                log.logf(1, "prog %d: %d/%d calls, %d cover PCs%s", i,
+                         len(res.calls), len(p.calls), total_cov,
+                         " [hanged]" if res.hanged else "")
+        log.logf(0, "executed %d programs x%d", len(progs), iteration)
+    finally:
+        env.close()
+
+
+if __name__ == "__main__":
+    main()

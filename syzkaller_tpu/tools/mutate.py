@@ -1,0 +1,35 @@
+"""One-shot program mutator (ref tools/syz-mutate, mutate.go:49).
+
+    python -m syzkaller_tpu.tools.mutate prog.txt -seed 1
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+import numpy as np
+
+from syzkaller_tpu import prog as P
+from syzkaller_tpu.sys.table import load_table
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser()
+    ap.add_argument("file", nargs="?", help="program file (default stdin)")
+    ap.add_argument("-descriptions", default="all")
+    ap.add_argument("-seed", type=int, default=0)
+    ap.add_argument("-ncalls", type=int, default=30)
+    args = ap.parse_args(argv)
+    table = load_table(files=None if args.descriptions in ("all", "linux")
+                       else [args.descriptions])
+    data = (open(args.file, "rb").read() if args.file
+            else sys.stdin.buffer.read())
+    p = P.deserialize(data, table)
+    rand = P.Rand(np.random.default_rng(args.seed))
+    P.mutate(p, rand, table, args.ncalls)
+    sys.stdout.buffer.write(P.serialize(p))
+
+
+if __name__ == "__main__":
+    main()

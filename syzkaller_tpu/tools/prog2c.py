@@ -1,0 +1,44 @@
+"""Program → standalone C source (ref tools/syz-prog2c, prog2c.go:60).
+
+    python -m syzkaller_tpu.tools.prog2c prog.txt -threaded -build
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+from syzkaller_tpu import csource
+from syzkaller_tpu import prog as P
+from syzkaller_tpu.sys.table import load_table
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser()
+    ap.add_argument("file", nargs="?", help="program file (default stdin)")
+    ap.add_argument("-descriptions", default="all")
+    ap.add_argument("-threaded", action="store_true")
+    ap.add_argument("-collide", action="store_true")
+    ap.add_argument("-repeat", action="store_true")
+    ap.add_argument("-procs", type=int, default=1)
+    ap.add_argument("-sandbox", default="none")
+    ap.add_argument("-build", action="store_true",
+                    help="also compile; prints the binary path to stderr")
+    args = ap.parse_args(argv)
+    table = load_table(files=None if args.descriptions in ("all", "linux")
+                       else [args.descriptions])
+    data = (open(args.file, "rb").read() if args.file
+            else sys.stdin.buffer.read())
+    p = P.deserialize(data, table)
+    opts = csource.Options(threaded=args.threaded, collide=args.collide,
+                           repeat=args.repeat, procs=args.procs,
+                           sandbox=args.sandbox)
+    src = csource.generate(p, opts)
+    sys.stdout.write(src)
+    if args.build:
+        path = csource.build(src)
+        print(f"built: {path}", file=sys.stderr)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,46 @@
+"""CLI for crash reproduction (ref tools/syz-repro, repro.go:85).
+
+    python -m syzkaller_tpu.tools.repro -config mgr.cfg crash.log
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+from syzkaller_tpu import prog as P
+from syzkaller_tpu import repro as repro_pkg
+from syzkaller_tpu.manager import config as config_mod
+from syzkaller_tpu.repro.repro import vm_test_fn
+from syzkaller_tpu.sys.table import load_table
+from syzkaller_tpu.utils import log
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser()
+    ap.add_argument("log", help="crash log file")
+    ap.add_argument("-config", required=True)
+    ap.add_argument("-vms", type=int, default=4,
+                    help="instances to use (ref manager peels off 4)")
+    ap.add_argument("-v", type=int, default=0)
+    args = ap.parse_args(argv)
+    log.set_verbosity(args.v)
+    cfg = config_mod.load(args.config)
+    table = load_table(files=None if cfg.descriptions in ("all", "linux")
+                       else [cfg.descriptions])
+    with open(args.log, "rb") as f:
+        crash_log = f.read()
+    test_fn = vm_test_fn(cfg, table, list(range(args.vms)),
+                         suppressions=cfg.compiled_suppressions())
+    result = repro_pkg.run(crash_log, table, test_fn)
+    if result is None or result.prog is None:
+        log.logf(0, "reproduction failed (%d attempts)",
+                 result.attempts if result else 0)
+        sys.exit(1)
+    sys.stdout.buffer.write(P.serialize(result.prog))
+    if result.c_repro:
+        sys.stdout.write("\n// ---- C reproducer ----\n" + result.c_repro)
+
+
+if __name__ == "__main__":
+    main()

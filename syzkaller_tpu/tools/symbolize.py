@@ -1,0 +1,35 @@
+"""Offline report processing (ref tools/syz-report + syz-symbolize,
+report.go:36, symbolize.go:41): parse a console log, print the crash
+description, optionally symbolize the stack trace against vmlinux.
+
+    python -m syzkaller_tpu.tools.symbolize crash.log -vmlinux ./vmlinux
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+from syzkaller_tpu import report as report_pkg
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser()
+    ap.add_argument("log")
+    ap.add_argument("-vmlinux", default="")
+    args = ap.parse_args(argv)
+    with open(args.log, "rb") as f:
+        data = f.read()
+    rep = report_pkg.parse(data)
+    if rep is None:
+        print("no crash found", file=sys.stderr)
+        sys.exit(1)
+    print(f"description: {rep.description}\n")
+    text = rep.text
+    if args.vmlinux:
+        text = report_pkg.symbolize_report(text, args.vmlinux)
+    sys.stdout.buffer.write(text)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,56 @@
+"""Corpus format migration: re-serialize a corpus dir with the current
+table/format (ref tools/syz-upgrade, upgrade.go:4-7). Programs that no
+longer parse are moved aside rather than deleted.
+
+    python -m syzkaller_tpu.tools.upgrade -corpus workdir/corpus
+"""
+
+from __future__ import annotations
+
+import argparse
+import hashlib
+import os
+
+from syzkaller_tpu import prog as P
+from syzkaller_tpu.sys.table import load_table
+from syzkaller_tpu.utils import log
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser()
+    ap.add_argument("-corpus", required=True)
+    ap.add_argument("-descriptions", default="all")
+    args = ap.parse_args(argv)
+    table = load_table(files=None if args.descriptions in ("all", "linux")
+                       else [args.descriptions])
+    broken_dir = os.path.join(args.corpus, "broken")
+    migrated = broken = kept = 0
+    for name in sorted(os.listdir(args.corpus)):
+        path = os.path.join(args.corpus, name)
+        if not os.path.isfile(path):
+            continue
+        with open(path, "rb") as f:
+            data = f.read()
+        try:
+            p = P.deserialize(data, table)
+            new_data = P.serialize(p)
+        except P.DeserializeError:
+            os.makedirs(broken_dir, exist_ok=True)
+            os.replace(path, os.path.join(broken_dir, name))
+            broken += 1
+            continue
+        if new_data == data:
+            kept += 1
+            continue
+        sig = hashlib.sha1(new_data).hexdigest()
+        with open(os.path.join(args.corpus, sig), "wb") as f:
+            f.write(new_data)
+        if sig != name:
+            os.unlink(path)
+        migrated += 1
+    log.logf(0, "upgrade: %d kept, %d migrated, %d broken",
+             kept, migrated, broken)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,170 @@
+"""csource + repro + tools tests.
+
+Strategy mirrors reference csource/csource_test.go:56 (random programs
+across option combinations must compile) and exercises the repro
+pipeline with a deterministic crash oracle instead of a VM fleet.
+"""
+
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+from syzkaller_tpu import csource
+from syzkaller_tpu import prog as P
+from syzkaller_tpu import repro as repro_pkg
+from syzkaller_tpu.sys.table import load_table
+
+pytestmark = pytest.mark.skipif(
+    os.system("gcc --version > /dev/null 2>&1") != 0, reason="no gcc")
+
+
+@pytest.fixture(scope="module")
+def table():
+    return load_table(files=["probe.txt"])
+
+
+def test_csource_builds_and_runs(table):
+    text = (b"r0 = syz_probe$res_new()\n"
+            b"syz_probe$res_use(r0)\n"
+            b"mmap(&(0x20001000/0x2000)=nil, (0x2000), 0x3, 0x32, "
+            b"0xffffffffffffffff, 0x0)\n")
+    p = P.deserialize(text, table)
+    src = csource.generate(p)
+    assert "syscall(" in src and "0x20001000" in src
+    binp = csource.build(src)
+    try:
+        r = subprocess.run([binp], timeout=10)
+        assert r.returncode == 0
+    finally:
+        os.unlink(binp)
+
+
+def test_csource_option_matrix(table):
+    r = P.Rand(np.random.default_rng(9))
+    combos = [
+        csource.Options(),
+        csource.Options(threaded=True),
+        csource.Options(threaded=True, collide=True),
+        csource.Options(procs=2, sandbox="setuid"),
+        csource.Options(sandbox="namespace"),
+    ]
+    for i, opts in enumerate(combos):
+        p = P.generate(r, table, ncalls=6)
+        binp = csource.build(csource.generate(p, opts))
+        os.unlink(binp)
+
+
+def test_csource_data_and_results(table):
+    text = (b"r0 = syz_probe$res_new()\n"
+            b'syz_probe$str(&(0x20000000)="70726f626500")\n'
+            b"syz_probe$res_use(r0)\n")
+    p = P.deserialize(text, table)
+    src = csource.generate(p)
+    assert "\\x70\\x72\\x6f\\x62\\x65\\x00" in src  # copyin of "probe\0"
+    assert "r[0]" in src                              # result var used
+
+
+CRASH_MARKER = "0xdeadbeef"
+
+
+def make_crash_log(table):
+    return (b"[ 1.0] boot\n"
+            b"executing program 0:\n"
+            b"syz_probe$ints(0x1, 0x2, 0x3, 0x4, 0x5)\n"
+            b"executing program 1:\n"
+            b"syz_probe$ints(0xdeadbeef, 0x2, 0x3, 0x4, 0x5)\n"
+            b"syz_probe()\n"
+            b"syz_probe$ranges(0x5, 0x1, 0x1, 0x0)\n"
+            b"executing program 0:\n"
+            b"syz_probe$ints(0x6, 0x2, 0x3, 0x4, 0x5)\n"
+            b"[ 2.0] BUG: KASAN: use-after-free in foo_bar+0x1/0x2\n"
+            b"[ 2.0] Write of size 8 at addr ffff8800\n")
+
+
+def crash_oracle(data: bytes, opts, duration: float) -> bool:
+    # "crashes" iff the deadbeef-valued call is present
+    return CRASH_MARKER.encode() in data
+
+
+def test_extract_suspects(table):
+    suspects = repro_pkg.repro.extract_suspects(make_crash_log(table), table)
+    # last-per-proc first: proc0's last prog and proc1's prog lead
+    assert len(suspects) == 3
+    texts = [P.serialize(s) for s in suspects]
+    assert any(CRASH_MARKER.encode() in t for t in texts)
+
+
+def test_repro_pipeline(table):
+    result = repro_pkg.run(make_crash_log(table), table, crash_oracle,
+                           quick=0.1, thorough=0.2)
+    assert result is not None and result.prog is not None
+    data = P.serialize(result.prog)
+    assert CRASH_MARKER.encode() in data
+    # minimization dropped the unrelated calls
+    assert len(result.prog.calls) == 1
+    # option simplification turned everything off (oracle ignores opts)
+    assert not result.opts.threaded and not result.opts.collide
+    assert result.opts.procs == 1 and not result.opts.repeat
+    assert result.c_repro and "syzkaller-tpu" in result.c_repro
+
+
+def test_repro_no_crash(table):
+    log = b"executing program 0:\nsyz_probe()\n"
+    assert repro_pkg.run(log, table, lambda *a: False,
+                         quick=0.1, thorough=0.1) is None
+
+
+def test_tools_cli(table, tmp_path):
+    # mutate + prog2c + execprog smoke via their mains
+    from syzkaller_tpu.tools import execprog, mutate, prog2c
+
+    prog_file = tmp_path / "p.txt"
+    prog_file.write_bytes(b"syz_probe$ints(0x1, 0x2, 0x3, 0x4, 0x5)\n")
+    out = subprocess.run(
+        [sys.executable, "-m", "syzkaller_tpu.tools.mutate",
+         str(prog_file), "-descriptions", "probe.txt", "-seed", "4"],
+        capture_output=True, timeout=120)
+    assert out.returncode == 0 and b"(" in out.stdout
+    out = subprocess.run(
+        [sys.executable, "-m", "syzkaller_tpu.tools.prog2c",
+         str(prog_file), "-descriptions", "probe.txt"],
+        capture_output=True, timeout=120)
+    assert out.returncode == 0 and b"int main" in out.stdout
+    out = subprocess.run(
+        [sys.executable, "-m", "syzkaller_tpu.tools.execprog",
+         "-file", str(prog_file), "-descriptions", "probe.txt"],
+        capture_output=True, timeout=120)
+    assert out.returncode == 0, out.stderr
+
+
+def test_upgrade_tool(table, tmp_path):
+    import hashlib
+
+    corpus = tmp_path / "corpus"
+    corpus.mkdir()
+    good = b"syz_probe$ints(0x1, 0x2, 0x3, 0x4, 0x5)\n"
+    (corpus / hashlib.sha1(good).hexdigest()).write_bytes(good)
+    (corpus / "badname").write_bytes(b"not_a_call_anymore(0x1)\n")
+    out = subprocess.run(
+        [sys.executable, "-m", "syzkaller_tpu.tools.upgrade",
+         "-corpus", str(corpus), "-descriptions", "probe.txt"],
+        capture_output=True, timeout=120, text=True)
+    assert out.returncode == 0
+    assert (corpus / "broken" / "badname").exists()
+
+
+def test_repro_c_verification(table):
+    ran = []
+
+    def c_oracle(binary_path, duration):
+        ran.append(binary_path)
+        return False  # C version "doesn't reproduce"
+
+    result = repro_pkg.run(make_crash_log(table), table, crash_oracle,
+                           c_test_fn=c_oracle, quick=0.1, thorough=0.2)
+    assert result is not None and result.prog is not None
+    assert len(ran) == 1 and not os.path.exists(ran[0])
+    assert result.c_repro is None  # dropped: did not reproduce
