@@ -1,0 +1,4 @@
+"""Federation: corpus exchange across managers (syz-hub equivalent)."""
+
+from syzkaller_tpu.hub.hub import Hub  # noqa: F401
+from syzkaller_tpu.hub.state import HubState  # noqa: F401

@@ -1,0 +1,3 @@
+from syzkaller_tpu.hub.hub import main
+
+main()

@@ -1,0 +1,86 @@
+"""syz-hub: corpus federation across managers.
+
+Capability parity with reference syz-hub/hub.go:62-99: shared-key
+authenticated RPC {Hub.Connect, Hub.Sync} over the same wire plane as
+manager↔fuzzer, persistent per-manager state, and an HTTP summary page.
+Cross-host federation rides DCN (SURVEY §2 TPU-native equivalent): each
+manager keeps its device-resident coverage matrix; the hub exchanges
+the *programs* (the durable state the matrices are rebuilt from).
+
+    python -m syzkaller_tpu.hub -addr :7788 -key SECRET -workdir ./hub
+"""
+
+from __future__ import annotations
+
+import argparse
+import threading
+import time
+
+from syzkaller_tpu import rpc
+from syzkaller_tpu.hub.state import HubState
+from syzkaller_tpu.utils import log
+
+
+class Hub:
+    def __init__(self, workdir: str, key: str = "",
+                 addr: str = "127.0.0.1:0"):
+        self.key = key
+        self.state = HubState(workdir)
+        self._mu = threading.Lock()
+        host, _, port = addr.rpartition(":")
+        self.server = rpc.RpcServer(host or "127.0.0.1", int(port or 0))
+        self.server.register("Hub.Connect", self.rpc_connect)
+        self.server.register("Hub.Sync", self.rpc_sync)
+        self.addr = self.server.addr
+
+    def _auth(self, params: dict) -> str:
+        if self.key and params.get("key") != self.key:
+            raise PermissionError("invalid hub key")
+        name = params.get("name", "")
+        if not name:
+            raise ValueError("missing manager name")
+        return name
+
+    def rpc_connect(self, params: dict) -> dict:
+        name = self._auth(params)
+        with self._mu:
+            self.state.connect(name, bool(params.get("fresh")),
+                               params.get("calls"))
+        log.logf(0, "hub: manager %s connected (fresh=%s)",
+                 name, bool(params.get("fresh")))
+        return {}
+
+    def rpc_sync(self, params: dict) -> dict:
+        name = self._auth(params)
+        add = [rpc.unb64(p) for p in params.get("add", [])]
+        with self._mu:
+            fresh = self.state.add(name, add)
+            progs, more = self.state.pending(name)
+        log.logf(1, "hub: sync %s: +%d fresh, -> %d progs (%d more)",
+                 name, fresh, len(progs), more)
+        return {"progs": [rpc.b64(p) for p in progs], "more": more}
+
+    def serve_background(self) -> None:
+        self.server.serve_background()
+
+    def close(self) -> None:
+        self.server.close()
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser()
+    ap.add_argument("-addr", default="127.0.0.1:7788")
+    ap.add_argument("-key", default="")
+    ap.add_argument("-workdir", default="./hub-workdir")
+    ap.add_argument("-v", type=int, default=0)
+    args = ap.parse_args(argv)
+    log.set_verbosity(args.v)
+    hub = Hub(args.workdir, args.key, args.addr)
+    log.logf(0, "hub listening on %s:%d", *hub.addr)
+    hub.server.serve_background()
+    while True:
+        time.sleep(60)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,128 @@
+"""Hub state: the federated global corpus with per-manager cursors.
+
+Capability parity with reference syz-hub/state/state.go:22-70: a global
+content-deduplicated corpus persisted as an append-ordered directory,
+per-manager sequence cursors (each manager pulls only what it hasn't
+seen), and call-set filtering so managers only receive programs whose
+calls they can execute.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+from dataclasses import dataclass, field
+
+from syzkaller_tpu.prog.encoding import call_set
+from syzkaller_tpu.utils import log
+
+
+@dataclass
+class ManagerState:
+    name: str
+    cursor: int = 0                  # index into the global sequence
+    calls: "set[str] | None" = None  # None = accepts everything
+    added: int = 0
+
+    def to_json(self) -> dict:
+        return {"cursor": self.cursor, "added": self.added,
+                "calls": sorted(self.calls) if self.calls is not None else None}
+
+
+class HubState:
+    def __init__(self, dirpath: str):
+        self.dir = dirpath
+        self.corpus_dir = os.path.join(dirpath, "corpus")
+        self.mgr_dir = os.path.join(dirpath, "managers")
+        os.makedirs(self.corpus_dir, exist_ok=True)
+        os.makedirs(self.mgr_dir, exist_ok=True)
+        # global sequence: list of (sig, data); order = admission order
+        self.seq: list[tuple[str, bytes]] = []
+        self.sigs: set[str] = set()
+        self.managers: dict[str, ManagerState] = {}
+        self._load()
+
+    def _load(self) -> None:
+        entries = []
+        for name in os.listdir(self.corpus_dir):
+            path = os.path.join(self.corpus_dir, name)
+            if not os.path.isfile(path):
+                continue
+            # files are "<seq>-<sig>" so ordering survives restart
+            try:
+                seq_s, sig = name.split("-", 1)
+                seqno = int(seq_s)
+            except ValueError:
+                continue
+            with open(path, "rb") as f:
+                entries.append((seqno, sig, f.read()))
+        for _seqno, sig, data in sorted(entries):
+            self.seq.append((sig, data))
+            self.sigs.add(sig)
+        for name in os.listdir(self.mgr_dir):
+            path = os.path.join(self.mgr_dir, name)
+            try:
+                with open(path) as f:
+                    meta = json.load(f)
+            except (OSError, json.JSONDecodeError):
+                continue
+            self.managers[name] = ManagerState(
+                name=name, cursor=int(meta.get("cursor", 0)),
+                calls=set(meta["calls"]) if meta.get("calls") is not None else None,
+                added=int(meta.get("added", 0)))
+        if self.seq:
+            log.logf(0, "hub: loaded %d corpus entries, %d managers",
+                     len(self.seq), len(self.managers))
+
+    def _save_manager(self, m: ManagerState) -> None:
+        with open(os.path.join(self.mgr_dir, m.name), "w") as f:
+            json.dump(m.to_json(), f)
+
+    def connect(self, name: str, fresh: bool,
+                calls: "list[str] | None") -> None:
+        m = self.managers.get(name)
+        if m is None or fresh:
+            m = ManagerState(name=name)
+        m.calls = set(calls) if calls is not None else None
+        self.managers[name] = m
+        self._save_manager(m)
+
+    def add(self, name: str, progs: list[bytes]) -> int:
+        """Programs pushed by a manager; returns how many were fresh."""
+        m = self.managers.setdefault(name, ManagerState(name=name))
+        fresh = 0
+        for data in progs:
+            sig = hashlib.sha1(data).hexdigest()
+            if sig in self.sigs:
+                continue
+            self.sigs.add(sig)
+            self.seq.append((sig, data))
+            m.added += 1
+            fresh += 1
+            with open(os.path.join(self.corpus_dir,
+                                   f"{len(self.seq) - 1:08d}-{sig}"),
+                      "wb") as f:
+                f.write(data)
+        self._save_manager(m)
+        return fresh
+
+    def pending(self, name: str, max_progs: int = 100
+                ) -> tuple[list[bytes], int]:
+        """Programs this manager hasn't seen (call-set filtered), plus a
+        count of how many more are waiting (ref Sync's More field)."""
+        m = self.managers.setdefault(name, ManagerState(name=name))
+        out: list[bytes] = []
+        while m.cursor < len(self.seq) and len(out) < max_progs:
+            sig, data = self.seq[m.cursor]
+            m.cursor += 1
+            if m.calls is not None:
+                try:
+                    if not call_set(data) <= m.calls:
+                        continue
+                except Exception:
+                    continue
+            out.append(data)
+        more = len(self.seq) - m.cursor
+        self._save_manager(m)
+        return out, more

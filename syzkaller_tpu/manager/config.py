@@ -56,6 +56,9 @@ class Config:
     boot_timeout: float = 600.0
     # repro
     reproduce: bool = True
+    # federation (syz-hub)
+    hub_addr: str = ""
+    hub_key: str = ""
 
     _BUILTIN_SUPPRESSIONS = [
         rb"panic: failed to start executor binary",

@@ -103,6 +103,8 @@ class Manager:
         self._stop = False
         self._last_prio_update = 0.0
         self._instances: dict[int, vm.Instance] = {}
+        self._hub_client: "rpc.RpcClient | None" = None
+        self._hub_synced: set[bytes] = set()
 
         self.server = rpc.RpcServer(*self._split_addr(cfg.rpc))
         self.server.register("Manager.Connect", self.rpc_connect)
@@ -233,6 +235,52 @@ class Manager:
                 continue
         self.engine.set_priorities(self.static_prios, call_mat)
 
+    # -- hub federation (ref manager.go:658-736) ---------------------------
+
+    def hub_sync_once(self) -> None:
+        """Push corpus programs the hub hasn't seen; pull fresh ones as
+        candidates (coverage state is rebuilt locally by re-triage)."""
+        if self._hub_client is None:
+            self._hub_client = rpc.RpcClient(self.cfg.hub_addr)
+            self._hub_client.call("Hub.Connect", {
+                "name": self.cfg.name, "key": self.cfg.hub_key,
+                "fresh": len(self.corpus) == 0,
+                "calls": self.enabled_names})
+        with self._mu:
+            new = [it.data for sig, it in self.corpus.items()
+                   if sig not in self._hub_synced]
+            for sig in self.corpus:
+                self._hub_synced.add(sig)
+        r = self._hub_client.call("Hub.Sync", {
+            "name": self.cfg.name, "key": self.cfg.hub_key,
+            "add": [rpc.b64(d) for d in new]})
+        pulled = 0
+        for pd in r.get("progs", []):
+            data = rpc.unb64(pd)
+            sig = hashlib.sha1(data).digest()
+            with self._mu:
+                if sig in self.corpus:
+                    continue
+                self.candidates.append(data)
+                pulled += 1
+        if new or pulled:
+            log.logf(0, "hub sync: sent %d, received %d (%d more)",
+                     len(new), pulled, int(r.get("more", 0)))
+
+    def hub_sync_loop(self) -> None:
+        while not self._stop:
+            try:
+                self.hub_sync_once()
+            except Exception as e:
+                log.logf(0, "hub sync failed: %s", e)
+                if self._hub_client is not None:
+                    self._hub_client.close()
+                    self._hub_client = None
+            for _ in range(60):
+                if self._stop:
+                    return
+                time.sleep(1.0)
+
     # -- corpus minimization (ref manager.go:504-550) ----------------------
 
     def minimize_corpus(self) -> int:
@@ -350,6 +398,8 @@ class Manager:
             t = threading.Thread(target=self.vm_loop, args=(i,), daemon=True)
             t.start()
             self.vm_threads.append(t)
+        if self.cfg.hub_addr:
+            threading.Thread(target=self.hub_sync_loop, daemon=True).start()
         log.logf(0, "manager up: rpc :%d, %d %s VM(s), %d corpus candidates",
                  self.rpc_port, self.cfg.count, self.cfg.type,
                  len(self.candidates))
