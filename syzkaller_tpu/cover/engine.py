@@ -64,15 +64,6 @@ def pack_pcs(pc_idx: jax.Array, valid: jax.Array, npcs: int) -> jax.Array:
     return (lanes * weights[None, None, :]).sum(axis=-1, dtype=jnp.uint32)
 
 
-def signal_diff(bitmaps: jax.Array, base: jax.Array,
-                call_ids: jax.Array) -> tuple[jax.Array, jax.Array]:
-    """new-signal mask per exec: bitmaps & ~base[call].  Returns
-    ((B, W) new-bit bitmaps, (B,) has-new verdicts)."""
-    prev = base[call_ids]                      # (B, W) gather
-    new = jnp.bitwise_and(bitmaps, jnp.bitwise_not(prev))
-    return new, jnp.any(new != 0, axis=-1)
-
-
 def scatter_or(base: jax.Array, call_ids: jax.Array,
                bitmaps: jax.Array) -> jax.Array:
     """base[call_ids[i]] |= bitmaps[i] for all i, duplicate-safe.
@@ -84,6 +75,25 @@ def scatter_or(base: jax.Array, call_ids: jax.Array,
         return acc.at[cid].set(jnp.bitwise_or(acc[cid], bitmaps[i]))
 
     return jax.lax.fori_loop(0, call_ids.shape[0], body, base)
+
+
+def diff_merge(base: jax.Array, call_ids: jax.Array, bitmaps: jax.Array
+               ) -> tuple[jax.Array, jax.Array, jax.Array]:
+    """Sequential diff-then-merge over the batch: row i's new-signal is
+    computed against base ∪ rows[0..i) of the same call, so two identical
+    new-coverage execs in one batch yield exactly one has_new verdict
+    (matching the reference, which processes execs one at a time).
+    Returns (merged base, (B, W) new bitmaps, (B,) has_new)."""
+
+    def body(acc, x):
+        cid, bm = x
+        prev = acc[cid]
+        new = jnp.bitwise_and(bm, jnp.bitwise_not(prev))
+        acc = acc.at[cid].set(jnp.bitwise_or(prev, bm))
+        return acc, new
+
+    merged, new = jax.lax.scan(body, base, (call_ids, bitmaps))
+    return merged, new, jnp.any(new != 0, axis=-1)
 
 
 def popcount_rows(mat: jax.Array) -> jax.Array:
@@ -151,8 +161,7 @@ def fuzz_step(max_cover: jax.Array, prios: jax.Array, enabled: jax.Array,
     call covers what the reference does per-exec in cover.Difference +
     cover.Union + prio.Choose (fuzzer.go:460-478, prio.go:230-249)."""
     bitmaps = pack_pcs(pc_idx, valid, npcs)
-    new, has_new = signal_diff(bitmaps, max_cover, call_ids)
-    merged = scatter_or(max_cover, call_ids, bitmaps)
+    merged, new, has_new = diff_merge(max_cover, call_ids, bitmaps)
     next_calls = sample_calls(key, prios, call_ids, enabled)
     return merged, new, has_new, next_calls
 
@@ -253,8 +262,7 @@ class CoverageEngine:
         @functools.partial(jax.jit, donate_argnums=(0,))
         def _update(max_cover, call_ids, pc_idx, valid):
             bitmaps = pack_pcs(pc_idx, valid, npcs)
-            new, has_new = signal_diff(bitmaps, max_cover, call_ids)
-            merged = scatter_or(max_cover, call_ids, bitmaps)
+            merged, new, has_new = diff_merge(max_cover, call_ids, bitmaps)
             return merged, new, has_new, bitmaps
 
         @functools.partial(jax.jit, donate_argnums=(0,))

@@ -177,6 +177,10 @@ class Env:
             self.stat_restarts += 1
 
         header = struct.pack("<QQQ", self.flags, self.pid, len(data) // 8)
+        if len(header) + len(data) > IN_SHM_SIZE:
+            raise ExecutorFailure(
+                f"program exec image too large for shm-in: "
+                f"{len(header) + len(data)} > {IN_SHM_SIZE} bytes")
         self._in_mm.seek(0)
         self._in_mm.write(header + data)
         self._out_mm.seek(0)
@@ -256,12 +260,14 @@ class Gate:
         self._busy = 0
         self._pos = 0
         self._running = [False] * size
+        self._stopping = False
         self._in_callback = False
         self._cv = threading.Condition()
 
     def enter(self) -> int:
         with self._cv:
-            while self._busy >= self.size or self._in_callback:
+            while (self._busy >= self.size or self._stopping
+                   or self._in_callback):
                 self._cv.wait()
             idx = self._pos
             self._pos = (self._pos + 1) % self.size
@@ -274,11 +280,23 @@ class Gate:
         with self._cv:
             self._running[idx] = False
             self._busy -= 1
-            if (idx == self.size - 1 and self.callback is not None
-                    and not any(self._running)):
-                run_cb = True
-                self._in_callback = True
             self._cv.notify_all()
+            if idx == self.size - 1 and self.callback is not None:
+                # Window closed: block new entries and drain every section
+                # still in flight, then run the callback exclusively
+                # (ref ipc/gate.go — without the drain, with >=2 procs the
+                # callback would almost never get a quiet instant to run).
+                # Window closings themselves serialize: a second closer
+                # (pos can wrap while the first drain is pending) waits
+                # until the first closer's callback has finished.
+                while self._stopping or self._in_callback:
+                    self._cv.wait()
+                self._stopping = True
+                while self._busy > 0:
+                    self._cv.wait()
+                self._stopping = False
+                self._in_callback = True
+                run_cb = True
         if run_cb:
             try:
                 self.callback()

@@ -12,6 +12,7 @@ from __future__ import annotations
 from typing import Callable
 
 from syzkaller_tpu.prog import analysis
+from syzkaller_tpu.prog import encoding
 from syzkaller_tpu.prog import model as M
 from syzkaller_tpu.prog.analysis import State
 from syzkaller_tpu.prog.rand import Gen, Rand
@@ -255,11 +256,17 @@ def minimize(p: M.Prog, call_index: int, pred: Pred,
             if pred(q, ni):
                 p, call_index = q, ni
         i -= 1
-    # 2. Per-arg simplification on every remaining call.
+    # 2. Per-arg simplification on every remaining call.  The tried memo
+    # is cleared whenever a simplification lands (the tree changed, so
+    # positional keys enumerated against the old tree are stale and must
+    # not mask retries); a simplification that leaves the tree
+    # byte-identical is skipped before it burns a pred execution and its
+    # key stays memoized, so the restart cannot loop forever.
     tried: set[tuple] = set()
     progress = True
     while progress:
         progress = False
+        content = encoding.serialize(p)
         for ci in range(len(p.calls)):
             # Paths are enumerated against the current p; as soon as a
             # simplification lands, restart enumeration — the old paths
@@ -273,9 +280,12 @@ def minimize(p: M.Prog, call_index: int, pred: Pred,
                 if not simplify(q.calls[ci], _arg_at(q.calls[ci], path)):
                     continue
                 analysis.assign_sizes_call(q.calls[ci])
+                if encoding.serialize(q) == content:
+                    continue  # no-op simplification: don't burn a pred exec
                 if pred(q, call_index):
                     p = q
                     progress = True
+                    tried.clear()
                     break
             if progress:
                 break

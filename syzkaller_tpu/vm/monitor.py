@@ -18,6 +18,7 @@ from syzkaller_tpu.vm.base import RunHandle
 NO_OUTPUT_TIMEOUT = 3 * 60.0      # ref vm.go: 3-min liveness
 WAIT_FOR_REPORT = 5.0             # collect the full oops after detection
 CONTEXT_WINDOW = 256 << 10        # ref vm.go 256KB window
+TAIL_OVERLAP = 1 << 10            # re-scan this much before each new chunk
 EXECUTING_MARKER = b"executing program"
 PREEMPTED_MARKER = b"PREEMPTED"
 
@@ -82,7 +83,12 @@ def monitor_execution(handle: RunHandle, timeout: float,
                            crashed=False, timed_out=True)
         if len(buf) - window_start > CONTEXT_WINDOW:
             window_start = len(buf) - CONTEXT_WINDOW // 2
-        if crashed_report is None and report_pkg.contains_crash(chunk, ignores):
+        # Scan the accumulated tail (new chunk + overlap), not the raw
+        # chunk: an oops anchor split across two console reads would
+        # otherwise be missed and a non-fatal oops silently dropped.
+        scan_start = max(window_start, len(buf) - len(chunk) - TAIL_OVERLAP)
+        if crashed_report is None and report_pkg.contains_crash(
+                bytes(buf[scan_start:]), ignores):
             # grab the full report: keep reading a little while
             crash_deadline = time.time() + WAIT_FOR_REPORT
             crashed_report = report_pkg.parse(window(), ignores)

@@ -162,3 +162,54 @@ def test_gate_concurrent():
     for t in ts:
         t.join()
     assert counter["max"] <= 4  # window bound held under contention
+
+
+def test_gate_drains_before_callback():
+    """With >=2 concurrent sections, the window-closing leave must block
+    new entries and wait for in-flight sections before the callback runs
+    (ADVICE r1: previously the callback was skipped unless the gate
+    happened to be momentarily empty)."""
+    import threading
+
+    events = []
+    mu = threading.Lock()
+
+    def cb():
+        with mu:
+            events.append("cb")
+
+    g = ipc.Gate(2, callback=cb)
+
+    def work():
+        for i in range(20):
+            with g.section():
+                with mu:
+                    events.append("s")
+
+    ts = [threading.Thread(target=work) for _ in range(4)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join()
+    n_sections = sum(1 for e in events if e == "s")
+    n_cbs = sum(1 for e in events if e == "cb")
+    assert n_sections == 80
+    # every window of 2 closes exactly once -> 40 callbacks
+    assert n_cbs == 40
+
+
+def test_exec_oversized_program_raises():
+    e = ipc.Env.__new__(ipc.Env)  # no spawn needed: size check is first
+    e.flags = 0
+    e.pid = 0
+    e._proc = object()  # pretend alive
+
+    class FakeProc:
+        def poll(self):
+            return None
+
+    e._proc = FakeProc()
+    import pytest as _pytest
+
+    with _pytest.raises(ipc.ExecutorFailure):
+        e.exec(b"\x00" * (ipc.env.IN_SHM_SIZE + 8))
