@@ -157,6 +157,14 @@ class _P:
 
     def num(self) -> int:
         self.ws()
+        neg = False
+        if self.i < len(self.s) and self.s[self.i] == "-":
+            neg = True
+            self.i += 1
+        v = self._unum()
+        return -v if neg else v
+
+    def _unum(self) -> int:
         st = self.i
         if self.s[self.i:self.i + 2].lower() == "0x":
             self.i += 2

@@ -31,6 +31,14 @@ BASE_INCLUDES = [
     "sys/random.h", "sys/time.h", "linux/netlink.h", "sys/ioctl.h",
 ]
 
+# The probe compiles against glibc headers, which redefine a few kernel
+# ABI constants for userspace convenience; the fuzzer needs the kernel
+# values (the reference extracts against a kernel checkout and gets
+# these right by construction).
+OVERRIDES = {
+    "O_LARGEFILE": 0o100000,
+}
+
 
 def collect_names(desc: parser.Description) -> tuple[set[str], set[str]]:
     """Return (symbolic constant names, kernel call names needing __NR_)."""
@@ -113,6 +121,9 @@ def extract(files: list[str], arch: str = "amd64", cc: str = "gcc",
         for line in out.stdout.splitlines():
             name, _, val = line.partition(" = ")
             values[name.strip()] = int(val)
+    for name, val in OVERRIDES.items():
+        if name in values:
+            values[name] = val
 
     if unresolved:
         print(f"unresolved ({len(unresolved)}): {', '.join(sorted(unresolved))}",
