@@ -24,9 +24,13 @@
 //   fd 5: request pipe (read 1 byte per execution request)
 //   fd 6: reply pipe  (write 1 status byte per completed request)
 
+#include <arpa/inet.h>
 #include <errno.h>
 #include <fcntl.h>
 #include <grp.h>
+#include <linux/if.h>
+#include <linux/if_tun.h>
+#include <net/if_arp.h>
 #include <pthread.h>
 #include <sched.h>
 #include <setjmp.h>
@@ -38,12 +42,16 @@
 #include <string.h>
 #include <sys/ioctl.h>
 #include <sys/mman.h>
+#include <sys/mount.h>
 #include <sys/prctl.h>
+#include <sys/socket.h>
 #include <sys/stat.h>
 #include <sys/syscall.h>
+#include <sys/sysmacros.h>
 #include <sys/time.h>
 #include <sys/types.h>
 #include <sys/wait.h>
+#include <termios.h>
 #include <time.h>
 #include <unistd.h>
 
@@ -69,6 +77,15 @@ const uint64_t no_result = ~(uint64_t)0;
 
 const uint64_t kPseudoNrBase = 1000000;
 
+// Pinned pseudo-syscall numbers (mirrors PSEUDO_NRS in
+// syzkaller_tpu/sys/types.py — keep in sync).
+const uint64_t kSyzOpenDev = kPseudoNrBase + 1;
+const uint64_t kSyzOpenPts = kPseudoNrBase + 2;
+const uint64_t kSyzFuseMount = kPseudoNrBase + 3;
+const uint64_t kSyzFuseblkMount = kPseudoNrBase + 4;
+const uint64_t kSyzEmitEthernet = kPseudoNrBase + 5;
+const uint64_t kSyzKvmSetupCpu = kPseudoNrBase + 6;
+
 // flags word (shm-in[0]); mirrored in syzkaller_tpu/ipc/env.py
 const uint64_t FLAG_DEBUG = 1 << 0;
 const uint64_t FLAG_COVER = 1 << 1;
@@ -78,6 +95,7 @@ const uint64_t FLAG_DEDUP_COVER = 1 << 4;
 const uint64_t FLAG_SANDBOX_SETUID = 1 << 5;
 const uint64_t FLAG_SANDBOX_NAMESPACE = 1 << 6;
 const uint64_t FLAG_FAKE_COVER = 1 << 7;
+const uint64_t FLAG_ENABLE_TUN = 1 << 8;
 
 // exit statuses (ref common.h:46-48, decoded by ipc/env.py)
 const int kFailStatus = 67;
@@ -219,45 +237,250 @@ static uint64_t mix64(uint64_t x)
 }
 
 // ---------------------------------------------------------------------------
-// Pseudo syscalls (nr >= kPseudoNrBase). The fixture syz_probe* family is a
-// no-op (ref sys/test.txt semantics: the descriptions are the mock,
-// host/host.go:64-65). Real syz_* helpers are dispatched by nr order of
-// first appearance per call_name — the Python compiler assigns them
-// deterministically and env.py passes a name table when needed.
+// Virtual network interface (ref common.h initialize_tun:213-259, done
+// here with raw ioctls instead of shelling out to `ip`).  One tap device
+// per executor proc, subnet 172.20.<proc>.0/24: local side .170 with mac
+// aa:aa:aa:aa:aa:aa, a permanent ARP entry for the remote side .187 at
+// bb:bb:bb:bb:bb:bb so outbound packets don't stall on resolution.
+// syz_emit_ethernet writes frames into the device = injects them into
+// the kernel's receive path.  Mirrored by the proc-typed addresses in
+// descriptions/linux/tun.txt.
 
-static long execute_pseudo(uint64_t nr, uint64_t a0, uint64_t a1, uint64_t a2,
-			   uint64_t a3, uint64_t a4, uint64_t a5)
+static int tun_fd = -1;
+
+static void tun_ifreq_name(struct ifreq* ifr, const char* name)
 {
-	(void)a3;
-	(void)a4;
-	(void)a5;
-	// Future: syz_open_dev / syz_open_pts / syz_emit_ethernet etc. keyed
-	// by a generated table. Unknown pseudo-calls are no-ops.
-	return 0;
+	memset(ifr, 0, sizeof(*ifr));
+	strncpy(ifr->ifr_name, name, IFNAMSIZ - 1);
 }
 
-static long execute_syscall(uint64_t nr, uint64_t a0, uint64_t a1, uint64_t a2,
-			    uint64_t a3, uint64_t a4, uint64_t a5)
+static void initialize_tun(uint64_t proc)
+{
+	if (tun_fd != -1)
+		return;
+	if (geteuid() != 0)
+		return; // interface config needs CAP_NET_ADMIN; stay silent
+	tun_fd = open("/dev/net/tun", O_RDWR);
+	if (tun_fd == -1) {
+		debug("tun: open /dev/net/tun failed: %d\n", errno);
+		return;
+	}
+	char name[IFNAMSIZ];
+	snprintf(name, sizeof(name), "syzt%d", (int)proc);
+	struct ifreq ifr;
+	tun_ifreq_name(&ifr, name);
+	ifr.ifr_flags = IFF_TAP | IFF_NO_PI;
+	if (ioctl(tun_fd, TUNSETIFF, &ifr) < 0) {
+		debug("tun: TUNSETIFF failed: %d\n", errno);
+		close(tun_fd);
+		tun_fd = -1;
+		return;
+	}
+	int ctl = socket(AF_INET, SOCK_DGRAM, 0);
+	if (ctl == -1) {
+		debug("tun: ctl socket failed\n");
+		return;
+	}
+	// local mac aa:...:aa
+	tun_ifreq_name(&ifr, name);
+	ifr.ifr_hwaddr.sa_family = ARPHRD_ETHER;
+	memset(ifr.ifr_hwaddr.sa_data, 0xaa, 6);
+	if (ioctl(ctl, SIOCSIFHWADDR, &ifr))
+		debug("tun: SIOCSIFHWADDR failed: %d\n", errno);
+	// local addr 172.20.<proc>.170/24
+	uint32_t subnet = (172u << 24) | (20u << 16) | (((uint32_t)proc & 0xff) << 8);
+	tun_ifreq_name(&ifr, name);
+	struct sockaddr_in* sin = (struct sockaddr_in*)&ifr.ifr_addr;
+	sin->sin_family = AF_INET;
+	sin->sin_addr.s_addr = htonl(subnet | 170);
+	if (ioctl(ctl, SIOCSIFADDR, &ifr))
+		debug("tun: SIOCSIFADDR failed: %d\n", errno);
+	tun_ifreq_name(&ifr, name);
+	sin = (struct sockaddr_in*)&ifr.ifr_netmask;
+	sin->sin_family = AF_INET;
+	sin->sin_addr.s_addr = htonl(0xffffff00);
+	if (ioctl(ctl, SIOCSIFNETMASK, &ifr))
+		debug("tun: SIOCSIFNETMASK failed: %d\n", errno);
+	// bring it up before the ARP entry: the entry needs a live device
+	tun_ifreq_name(&ifr, name);
+	if (ioctl(ctl, SIOCGIFFLAGS, &ifr) == 0) {
+		ifr.ifr_flags |= IFF_UP | IFF_RUNNING;
+		if (ioctl(ctl, SIOCSIFFLAGS, &ifr))
+			debug("tun: SIOCSIFFLAGS failed: %d\n", errno);
+	}
+	// permanent ARP entry for the remote peer .187 -> bb:...:bb
+	struct arpreq arp;
+	memset(&arp, 0, sizeof(arp));
+	sin = (struct sockaddr_in*)&arp.arp_pa;
+	sin->sin_family = AF_INET;
+	sin->sin_addr.s_addr = htonl(subnet | 187);
+	arp.arp_ha.sa_family = ARPHRD_ETHER;
+	memset(arp.arp_ha.sa_data, 0xbb, 6);
+	arp.arp_flags = ATF_PERM | ATF_COM;
+	strncpy(arp.arp_dev, name, sizeof(arp.arp_dev) - 1);
+	if (ioctl(ctl, SIOCSARP, &arp))
+		debug("tun: SIOCSARP failed: %d\n", errno);
+	close(ctl);
+	debug("tun: %s up, subnet 172.20.%d.0/24\n", name, (int)(proc & 0xff));
+}
+
+// ---------------------------------------------------------------------------
+// Pseudo syscalls (nr >= kPseudoNrBase; pinned numbers above).  Behavior
+// parity with the reference helpers (common.h:262-371); fuzzer-controlled
+// pointers are only dereferenced under SEGV containment.  syz_* names
+// outside this set (the syz_probe* test fixture family, dynamic nrs
+// 1000100+) are deliberate no-ops: the descriptions are the mock
+// (ref sys/test.txt semantics, host/host.go:64-65).
+
+static long syz_open_dev(uint64_t a0, uint64_t a1, uint64_t a2)
+{
+	if (a0 == 0xc || a0 == 0xb) {
+		// (kind const[0xc|0xb], major, minor): numbered device nodes
+		// (Linux majors are 12 bits, minors 20 — no byte truncation)
+		char path[64];
+		snprintf(path, sizeof(path), "/dev/%s/%u:%u",
+			 a0 == 0xc ? "char" : "block",
+			 (unsigned)(a1 & 0xfff), (unsigned)(a2 & 0xfffff));
+		return open(path, O_RDWR, 0);
+	}
+	// (template string with '#' placeholders, id, flags); the LAST '#'
+	// takes the least-significant digit so multi-# templates read as a
+	// decimal id, e.g. card## with id 12 -> card12
+	char path[512];
+	path[0] = 0;
+	NONFAILING(strncpy(path, (const char*)a0, sizeof(path) - 1));
+	path[sizeof(path) - 1] = 0;
+	uint64_t id = a1;
+	for (size_t i = strlen(path); i-- > 0;) {
+		if (path[i] == '#') {
+			path[i] = '0' + (char)(id % 10);
+			id /= 10;
+		}
+	}
+	return open(path, a2, 0);
+}
+
+static long syz_open_pts(uint64_t a0, uint64_t a1)
+{
+	int pts = -1;
+	if (ioctl(a0, TIOCGPTN, &pts))
+		return -1;
+	char path[32];
+	snprintf(path, sizeof(path), "/dev/pts/%d", pts);
+	return open(path, a1, 0);
+}
+
+// Shared tail of the two fuse mounts: open /dev/fuse, build the option
+// string, mount.  Mount errors are ignored on purpose — the raw fd is
+// fuzzing surface by itself (matches reference intent).
+static long fuse_mount_common(const char* fstype, uint64_t target_ptr,
+			      const char* blkdev, uint64_t mode, uint64_t uid,
+			      uint64_t gid, uint64_t maxread, uint64_t blksize,
+			      uint64_t mnt_flags)
+{
+	int fd = open("/dev/fuse", O_RDWR);
+	if (fd == -1)
+		return -1;
+	char opts[256];
+	int n = snprintf(opts, sizeof(opts),
+			 "fd=%d,user_id=%llu,group_id=%llu,rootmode=0%o", fd,
+			 (unsigned long long)uid, (unsigned long long)gid,
+			 (unsigned)mode & ~3u);
+	if (maxread)
+		n += snprintf(opts + n, sizeof(opts) - n, ",max_read=%llu",
+			      (unsigned long long)maxread);
+	if (blksize)
+		n += snprintf(opts + n, sizeof(opts) - n, ",blksize=%llu",
+			      (unsigned long long)blksize);
+	if (mode & 1)
+		n += snprintf(opts + n, sizeof(opts) - n, ",default_permissions");
+	if (mode & 2)
+		n += snprintf(opts + n, sizeof(opts) - n, ",allow_other");
+	char target[256];
+	target[0] = 0;
+	NONFAILING(strncpy(target, (const char*)target_ptr, sizeof(target) - 1));
+	target[sizeof(target) - 1] = 0;
+	mkdir(target, 0777);
+	NONFAILING(syscall(SYS_mount, blkdev ? blkdev : "", target, fstype,
+			   mnt_flags, opts));
+	return fd;
+}
+
+static long syz_fuse_mount(uint64_t a0, uint64_t a1, uint64_t a2, uint64_t a3,
+			   uint64_t a4, uint64_t a5)
+{
+	// (target, mode, uid, gid, maxread, mount_flags)
+	return fuse_mount_common("fuse", a0, NULL, a1, a2, a3, a4, 0, a5);
+}
+
+static long syz_fuseblk_mount(uint64_t a0, uint64_t a1, uint64_t a2,
+			      uint64_t a3, uint64_t a4, uint64_t a5,
+			      uint64_t a6, uint64_t a7)
+{
+	// (target, blkdev, mode, uid, gid, maxread, blksize, mount_flags)
+	char blkdev[256];
+	blkdev[0] = 0;
+	NONFAILING(strncpy(blkdev, (const char*)a1, sizeof(blkdev) - 1));
+	blkdev[sizeof(blkdev) - 1] = 0;
+	// a loop-backed node so mount("fuseblk") has a block device to claim
+	if (mknod(blkdev, S_IFBLK | 0666, makedev(7, 199)) && errno != EEXIST)
+		debug("fuseblk: mknod failed: %d\n", errno);
+	return fuse_mount_common("fuseblk", a0, blkdev, a2, a3, a4, a5, a6, a7);
+}
+
+static long syz_emit_ethernet(uint64_t a0, uint64_t a1)
+{
+	// (frame ptr, frame len)
+	if (tun_fd < 0)
+		return -1;
+	long res = -1;
+	NONFAILING(res = write(tun_fd, (const void*)a0, a1));
+	return res;
+}
+
+static long execute_pseudo(uint64_t nr, uint64_t a[9])
+{
+	switch (nr) {
+	case kSyzOpenDev:
+		return syz_open_dev(a[0], a[1], a[2]);
+	case kSyzOpenPts:
+		return syz_open_pts(a[0], a[1]);
+	case kSyzFuseMount:
+		return syz_fuse_mount(a[0], a[1], a[2], a[3], a[4], a[5]);
+	case kSyzFuseblkMount:
+		return syz_fuseblk_mount(a[0], a[1], a[2], a[3], a[4], a[5],
+					 a[6], a[7]);
+	case kSyzEmitEthernet:
+		return syz_emit_ethernet(a[0], a[1]);
+	case kSyzKvmSetupCpu: // not implemented yet (needs ifuzz text args)
+	default:
+		return 0;
+	}
+}
+
+static long execute_syscall(uint64_t nr, uint64_t a[9])
 {
 	if (nr >= kPseudoNrBase)
-		return execute_pseudo(nr, a0, a1, a2, a3, a4, a5);
-	return syscall(nr, a0, a1, a2, a3, a4, a5);
+		return execute_pseudo(nr, a);
+	return syscall(nr, a[0], a[1], a[2], a[3], a[4], a[5]);
 }
 
 // ---------------------------------------------------------------------------
 // Program representation after decode.
+
+const int kMaxArgs = 9; // syz_fuseblk_mount takes 8 (ref runs to a8)
 
 struct Call {
 	uint32_t index;
 	uint64_t nr;
 	uint64_t result_idx;
 	uint64_t nargs;
-	uint64_t args[6];
+	uint64_t args[kMaxArgs];
 	// arg refs: for result args we must resolve at execution time
-	uint64_t arg_kind[6]; // arg_const or arg_result
-	uint64_t arg_ref[6];  // result index
-	uint64_t arg_div[6];
-	uint64_t arg_add[6];
+	uint64_t arg_kind[kMaxArgs]; // arg_const or arg_result
+	uint64_t arg_ref[kMaxArgs];  // result index
+	uint64_t arg_div[kMaxArgs];
+	uint64_t arg_add[kMaxArgs];
 };
 
 struct Copyin {
@@ -364,8 +587,8 @@ static int dedup_sort(uint32_t* cover, uint32_t n)
 static void execute_call_on_thread(Thread* t)
 {
 	Call* c = t->call;
-	uint64_t a[6] = {0, 0, 0, 0, 0, 0};
-	for (uint64_t i = 0; i < c->nargs && i < 6; i++)
+	uint64_t a[kMaxArgs] = {};
+	for (uint64_t i = 0; i < c->nargs && i < kMaxArgs; i++)
 		a[i] = resolve_arg(c->arg_kind[i], c->args[i], c->arg_ref[i],
 				   c->arg_div[i], c->arg_add[i]);
 	bool kcov = false;
@@ -377,7 +600,7 @@ static void execute_call_on_thread(Thread* t)
 		cover_reset(&th_cover);
 	}
 	errno = 0;
-	long res = execute_syscall(c->nr, a[0], a[1], a[2], a[3], a[4], a[5]);
+	long res = execute_syscall(c->nr, a);
 	int err = res == -1 ? errno : 0;
 	t->retval = res;
 	t->err = err;
@@ -554,7 +777,7 @@ static void decode_prog(uint64_t* words, size_t nwords, Prog* p, char* data_area
 		if (c->result_idx != no_result && c->result_idx >= kMaxCommands)
 			fail("call result out of range");
 		c->nargs = read_word(&d);
-		if (c->nargs > 6)
+		if (c->nargs > (uint64_t)kMaxArgs)
 			fail("too many args");
 		for (uint64_t i = 0; i < c->nargs; i++) {
 			uint64_t size;
@@ -678,13 +901,105 @@ static void sandbox_setuid()
 		debug("setresuid failed\n");
 }
 
+// Bind one device node into the pivot'd rootfs (best-effort: nodes that
+// don't exist on the host are simply absent in the sandbox).
+static void sandbox_bind_dev(const char* newroot, const char* dev)
+{
+	char path[256];
+	snprintf(path, sizeof(path), "%s%s", newroot, dev);
+	int fd = open(path, O_WRONLY | O_CREAT | O_CLOEXEC, 0600);
+	if (fd == -1)
+		return;
+	close(fd);
+	if (mount(dev, path, NULL, MS_BIND, NULL))
+		unlink(path);
+}
+
+// Mount/pivot portion of the namespace sandbox; any failure returns
+// false and the caller still drops privileges.
+static bool sandbox_pivot()
+{
+	if (unshare(CLONE_NEWNS | CLONE_NEWIPC | CLONE_NEWUTS)) {
+		debug("unshare(ns) failed: %d\n", errno);
+		return false;
+	}
+	// stop mount events from leaking back to the parent namespace
+	if (mount(NULL, "/", NULL, MS_REC | MS_PRIVATE, NULL)) {
+		debug("mount --make-rprivate failed: %d\n", errno);
+		return false;
+	}
+	const char* newroot = "./pivot";
+	if (mkdir(newroot, 0777) && errno != EEXIST)
+		return false;
+	if (mount("syz-tmpfs", newroot, "tmpfs", 0, "size=64m")) {
+		debug("tmpfs mount failed: %d\n", errno);
+		return false;
+	}
+	char devdir[256], ptsdir[256], olddir[256], ptmx[256];
+	snprintf(devdir, sizeof(devdir), "%s/dev", newroot);
+	snprintf(ptsdir, sizeof(ptsdir), "%s/dev/pts", newroot);
+	snprintf(olddir, sizeof(olddir), "%s/.old", newroot);
+	snprintf(ptmx, sizeof(ptmx), "%s/dev/ptmx", newroot);
+	mkdir(devdir, 0755);
+	static const char* kDevs[] = {
+	    "/dev/null", "/dev/zero", "/dev/full", "/dev/random",
+	    "/dev/urandom", "/dev/fuse", "/dev/kvm",
+	};
+	for (size_t i = 0; i < sizeof(kDevs) / sizeof(kDevs[0]); i++)
+		sandbox_bind_dev(newroot, kDevs[i]);
+	mkdir(ptsdir, 0755);
+	if (mount("devpts", ptsdir, "devpts", 0, "newinstance,ptmxmode=0666"))
+		debug("devpts mount failed: %d\n", errno);
+	// ptmx must pair with OUR devpts instance, not the host's — a bound
+	// host ptmx would allocate slave indices invisible under /dev/pts
+	if (symlink("pts/ptmx", ptmx))
+		debug("ptmx symlink failed: %d\n", errno);
+	char netdir[256];
+	snprintf(netdir, sizeof(netdir), "%s/dev/net", newroot);
+	mkdir(netdir, 0755);
+	sandbox_bind_dev(newroot, "/dev/net/tun");
+	mkdir(olddir, 0777);
+	if (syscall(SYS_pivot_root, newroot, olddir)) {
+		debug("pivot_root failed: %d\n", errno);
+		bool ok = chroot(newroot) == 0;
+		if (!ok)
+			debug("chroot fallback failed: %d\n", errno);
+		if (chdir("/"))
+			debug("chdir failed\n");
+		return ok;
+	}
+	if (chdir("/"))
+		debug("chdir / failed\n");
+	if (umount2("/.old", MNT_DETACH))
+		debug("umount old root failed: %d\n", errno);
+	rmdir("/.old");
+	return true;
+}
+
 static void sandbox_namespace()
 {
-	// best-effort: user+mount+net namespaces; fall through when the
-	// kernel/container denies them (ref common.h namespace sandbox with
-	// pivot_root; full rootfs isolation needs the VM environment).
-	if (unshare(CLONE_NEWUSER | CLONE_NEWNS | CLONE_NEWNET))
-		debug("unshare failed: %d\n", errno);
+	// Full isolation when root (the in-VM case): fresh mount/ipc/uts
+	// namespaces, then pivot_root into a private tmpfs with a
+	// whitelisted /dev, so the program can't touch the real filesystem
+	// (ref common.h:462-585).  The tun fd and /proc access survive
+	// because fds opened before the pivot keep their objects.
+	if (geteuid() != 0) {
+		// unprivileged: best-effort user+mount+net namespaces
+		if (unshare(CLONE_NEWUSER | CLONE_NEWNS | CLONE_NEWNET))
+			debug("unshare failed: %d\n", errno);
+		return;
+	}
+	if (!sandbox_pivot())
+		debug("sandbox: running on real rootfs (pivot failed)\n");
+	// drop to an unprivileged identity on EVERY path — a failed pivot
+	// must not leave the fuzzed program running as root on the real fs
+	const int sandbox_uid = 65534;
+	if (setgroups(0, NULL))
+		debug("setgroups failed\n");
+	if (setresgid(sandbox_uid, sandbox_uid, sandbox_uid))
+		debug("setresgid failed\n");
+	if (setresuid(sandbox_uid, sandbox_uid, sandbox_uid))
+		debug("setresuid failed\n");
 }
 
 // ---------------------------------------------------------------------------
@@ -802,6 +1117,8 @@ int main(int argc, char** argv)
 		flag_sandbox_setuid = flags & FLAG_SANDBOX_SETUID;
 		flag_sandbox_namespace = flags & FLAG_SANDBOX_NAMESPACE;
 		flag_fake_cover = flags & FLAG_FAKE_COVER;
+		if (flags & FLAG_ENABLE_TUN)
+			initialize_tun(proc_pid); // once; workers inherit the fd
 
 		if (prog_len * 8 > kInSize - 24)
 			fail("program too large");

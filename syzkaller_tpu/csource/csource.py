@@ -21,6 +21,7 @@ import numpy as np
 
 from syzkaller_tpu.prog import encodingexec as EE
 from syzkaller_tpu.prog import model as M
+from syzkaller_tpu.sys import types as T
 
 
 @dataclass
@@ -31,6 +32,7 @@ class Options:
     procs: int = 1
     sandbox: str = "none"     # none | setuid | namespace
     pid: int = 0
+    tun: bool = False         # set up the syzt<pid> tap device first
 
 
 class BuildError(Exception):
@@ -171,8 +173,13 @@ def generate(p: M.Prog, opts: "Options | None" = None) -> str:
                 body.append(f"\t\tNONFAILING(*(volatile {ctyp}*)"
                             f"0x{cin.addr:x} = ({ctyp})({expr}));")
         argv = ", ".join(_arg_expr(a) for a in c.args)
-        call_expr = (f"syscall(0x{c.nr:x}ul{', ' if argv else ''}{argv})"
-                     if c.nr < 1000000 else "0 /* pseudo: " + c.name + " */")
+        if c.nr < 1000000:
+            call_expr = f"syscall(0x{c.nr:x}ul{', ' if argv else ''}{argv})"
+        elif c.nr in _PSEUDO_NR_SET:
+            padded = [_arg_expr(a) for a in c.args] + ["0"] * (9 - len(c.args))
+            call_expr = f"syz_pseudo(0x{c.nr:x}ul, {', '.join(padded)})"
+        else:
+            call_expr = "0 /* pseudo no-op: " + c.name + " */"
         if c.result_idx is not None:
             body.append(f"\t\tr[{c.result_idx}] = {call_expr}; "
                         f"/* {c.name} */")
@@ -188,6 +195,17 @@ def generate(p: M.Prog, opts: "Options | None" = None) -> str:
     parts = [_HEADER, f"static uint64_t r[{nresults}];",
              f"#define NCALLS {len(calls)}",
              _SEGV_HELPERS]
+    if opts.tun or any(c.nr in _PSEUDO_NR_SET for c in calls):
+        helpers = _PSEUDO_HELPERS
+        for token, name in (("%NR_OPEN_DEV%", "syz_open_dev"),
+                            ("%NR_OPEN_PTS%", "syz_open_pts"),
+                            ("%NR_FUSE_MOUNT%", "syz_fuse_mount"),
+                            ("%NR_FUSEBLK_MOUNT%", "syz_fuseblk_mount"),
+                            ("%NR_EMIT_ETHERNET%", "syz_emit_ethernet")):
+            helpers = helpers.replace(token, str(T.PSEUDO_NRS[name]))
+        parts.append(helpers)
+    else:
+        parts.append("static void initialize_tun(int proc) { (void)proc; }")
     if opts.threaded or opts.collide:
         parts.append(_THREADED_RUNNER.replace(
             "%COLLIDE%", "1" if opts.collide else "0"))
@@ -277,6 +295,140 @@ static void execute_prog(void) {
 }
 """
 
+# Pinned pseudo-syscall numbers (syzkaller_tpu/sys/types.py PSEUDO_NRS);
+# the emitted helpers mirror native/executor.cc behavior.  kvm_setup_cpu
+# is not implemented yet, so it stays a no-op in repros too.
+_PSEUDO_NR_SET = frozenset(
+    v for k, v in T.PSEUDO_NRS.items() if k != "syz_kvm_setup_cpu")
+
+_PSEUDO_HELPERS = """
+#include <arpa/inet.h>
+#include <fcntl.h>
+#include <linux/if.h>
+#include <linux/if_tun.h>
+#include <net/if_arp.h>
+#include <sys/ioctl.h>
+#include <sys/socket.h>
+#include <sys/stat.h>
+#include <sys/sysmacros.h>
+#include <termios.h>
+#include <errno.h>
+
+static int tun_fd = -1;
+
+static void initialize_tun(int proc)
+{
+\tif (geteuid() != 0) return;
+\ttun_fd = open("/dev/net/tun", O_RDWR);
+\tif (tun_fd == -1) return;
+\tchar name[IFNAMSIZ];
+\tsnprintf(name, sizeof(name), "syzt%d", proc);
+\tstruct ifreq ifr;
+\tmemset(&ifr, 0, sizeof(ifr));
+\tstrncpy(ifr.ifr_name, name, IFNAMSIZ - 1);
+\tifr.ifr_flags = IFF_TAP | IFF_NO_PI;
+\tif (ioctl(tun_fd, TUNSETIFF, &ifr) < 0) { close(tun_fd); tun_fd = -1; return; }
+\tint ctl = socket(AF_INET, SOCK_DGRAM, 0);
+\tif (ctl == -1) return;
+\tuint32_t subnet = (172u << 24) | (20u << 16) | (((uint32_t)proc & 0xff) << 8);
+\tmemset(&ifr, 0, sizeof(ifr)); strncpy(ifr.ifr_name, name, IFNAMSIZ - 1);
+\tifr.ifr_hwaddr.sa_family = ARPHRD_ETHER; memset(ifr.ifr_hwaddr.sa_data, 0xaa, 6);
+\tioctl(ctl, SIOCSIFHWADDR, &ifr);
+\tmemset(&ifr, 0, sizeof(ifr)); strncpy(ifr.ifr_name, name, IFNAMSIZ - 1);
+\tstruct sockaddr_in* sin = (struct sockaddr_in*)&ifr.ifr_addr;
+\tsin->sin_family = AF_INET; sin->sin_addr.s_addr = htonl(subnet | 170);
+\tioctl(ctl, SIOCSIFADDR, &ifr);
+\tmemset(&ifr, 0, sizeof(ifr)); strncpy(ifr.ifr_name, name, IFNAMSIZ - 1);
+\tsin = (struct sockaddr_in*)&ifr.ifr_netmask;
+\tsin->sin_family = AF_INET; sin->sin_addr.s_addr = htonl(0xffffff00);
+\tioctl(ctl, SIOCSIFNETMASK, &ifr);
+\tmemset(&ifr, 0, sizeof(ifr)); strncpy(ifr.ifr_name, name, IFNAMSIZ - 1);
+\tif (ioctl(ctl, SIOCGIFFLAGS, &ifr) == 0) {
+\t\tifr.ifr_flags |= IFF_UP | IFF_RUNNING;
+\t\tioctl(ctl, SIOCSIFFLAGS, &ifr);
+\t}
+\tstruct arpreq arp;
+\tmemset(&arp, 0, sizeof(arp));
+\tsin = (struct sockaddr_in*)&arp.arp_pa;
+\tsin->sin_family = AF_INET; sin->sin_addr.s_addr = htonl(subnet | 187);
+\tarp.arp_ha.sa_family = ARPHRD_ETHER; memset(arp.arp_ha.sa_data, 0xbb, 6);
+\tarp.arp_flags = ATF_PERM | ATF_COM;
+\tstrncpy(arp.arp_dev, name, sizeof(arp.arp_dev) - 1);
+\tioctl(ctl, SIOCSARP, &arp);
+\tclose(ctl);
+}
+
+static long syz_pseudo(uint64_t nr, uint64_t a0, uint64_t a1, uint64_t a2,
+\t\tuint64_t a3, uint64_t a4, uint64_t a5, uint64_t a6,
+\t\tuint64_t a7, uint64_t a8)
+{
+\t(void)a8;
+\tswitch (nr) {
+\tcase 1000001: { /* syz_open_dev */
+\t\tif (a0 == 0xc || a0 == 0xb) {
+\t\t\tchar p[64];
+\t\t\tsnprintf(p, sizeof(p), "/dev/%s/%u:%u",
+\t\t\t\ta0 == 0xc ? "char" : "block", (unsigned)(uint8_t)a1,
+\t\t\t\t(unsigned)(uint8_t)a2);
+\t\t\treturn open(p, O_RDWR, 0);
+\t\t}
+\t\tchar p[512]; p[0] = 0;
+\t\tNONFAILING(strncpy(p, (const char*)a0, sizeof(p) - 1));
+\t\tp[sizeof(p) - 1] = 0;
+\t\tfor (char* c = p; *c; c++)
+\t\t\tif (*c == '#') { *c = '0' + (char)(a1 % 10); a1 /= 10; }
+\t\treturn open(p, a2, 0);
+\t}
+\tcase 1000002: { /* syz_open_pts */
+\t\tint pts = -1;
+\t\tif (ioctl(a0, TIOCGPTN, &pts)) return -1;
+\t\tchar p[32];
+\t\tsnprintf(p, sizeof(p), "/dev/pts/%d", pts);
+\t\treturn open(p, a1, 0);
+\t}
+\tcase 1000003:   /* syz_fuse_mount */
+\tcase 1000004: { /* syz_fuseblk_mount */
+\t\tint blk = nr == 1000004;
+\t\tuint64_t mode = blk ? a2 : a1, uid = blk ? a3 : a2;
+\t\tuint64_t gid = blk ? a4 : a3, maxread = blk ? a5 : a4;
+\t\tuint64_t blksize = blk ? a6 : 0, mf = blk ? a7 : a5;
+\t\tint fd = open("/dev/fuse", O_RDWR);
+\t\tif (fd == -1) return -1;
+\t\tchar opts[256];
+\t\tint n = snprintf(opts, sizeof(opts),
+\t\t\t"fd=%d,user_id=%llu,group_id=%llu,rootmode=0%o", fd,
+\t\t\t(unsigned long long)uid, (unsigned long long)gid,
+\t\t\t(unsigned)mode & ~3u);
+\t\tif (maxread) n += snprintf(opts + n, sizeof(opts) - n, ",max_read=%llu", (unsigned long long)maxread);
+\t\tif (blksize) n += snprintf(opts + n, sizeof(opts) - n, ",blksize=%llu", (unsigned long long)blksize);
+\t\tif (mode & 1) n += snprintf(opts + n, sizeof(opts) - n, ",default_permissions");
+\t\tif (mode & 2) n += snprintf(opts + n, sizeof(opts) - n, ",allow_other");
+\t\tchar target[256]; target[0] = 0;
+\t\tNONFAILING(strncpy(target, (const char*)a0, sizeof(target) - 1));
+\t\ttarget[sizeof(target) - 1] = 0;
+\t\tmkdir(target, 0777);
+\t\tif (blk) {
+\t\t\tchar bdev[256]; bdev[0] = 0;
+\t\t\tNONFAILING(strncpy(bdev, (const char*)a1, sizeof(bdev) - 1));
+\t\t\tbdev[sizeof(bdev) - 1] = 0;
+\t\t\tmknod(bdev, S_IFBLK | 0666, makedev(7, 199));
+\t\t\tNONFAILING(syscall(SYS_mount, bdev, target, "fuseblk", mf, opts));
+\t\t} else {
+\t\t\tNONFAILING(syscall(SYS_mount, "", target, "fuse", mf, opts));
+\t\t}
+\t\treturn fd;
+\t}
+\tcase 1000005: { /* syz_emit_ethernet */
+\t\tif (tun_fd < 0) return -1;
+\t\tlong res = -1;
+\t\tNONFAILING(res = write(tun_fd, (const void*)a0, a1));
+\t\treturn res;
+\t}
+\t}
+\treturn 0;
+}
+"""
+
 _SANDBOX_SETUID = """
 static void sandbox(void) {
 \tprctl(PR_SET_PDEATHSIG, SIGKILL);
@@ -295,18 +447,19 @@ static void sandbox(void) {
 
 
 def _main_fn(opts: Options) -> str:
-    one_run = """\
+    one_run = f"""\
 \t\tint pid = fork();
-\t\tif (pid == 0) {
+\t\tif (pid == 0) {{
 \t\t\tinstall_segv();
+\t\t\tinitialize_tun({opts.pid});
 \t\t\tsandbox();
 \t\t\tmmap((void*)0x20000000ul, 16 << 20, PROT_READ | PROT_WRITE,
 \t\t\t     MAP_PRIVATE | MAP_ANONYMOUS | MAP_FIXED, -1, 0);
 \t\t\texecute_prog();
 \t\t\t_exit(0);
-\t\t}
+\t\t}}
 \t\tint status;
-\t\twhile (waitpid(pid, &status, 0) != pid) {}"""
+\t\twhile (waitpid(pid, &status, 0) != pid) {{}}"""
     if opts.repeat:
         loop = f"\tfor (;;) {{\n{one_run}\n\t}}"
     else:

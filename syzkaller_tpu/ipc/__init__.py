@@ -1,7 +1,8 @@
 """IPC: shared-memory + pipe protocol between fuzzer and executor."""
 
 from syzkaller_tpu.ipc.env import (  # noqa: F401
-    FLAG_COLLIDE, FLAG_COVER, FLAG_DEBUG, FLAG_DEDUP_COVER, FLAG_FAKE_COVER,
-    FLAG_SANDBOX_NAMESPACE, FLAG_SANDBOX_SETUID, FLAG_THREADED,
+    FLAG_COLLIDE, FLAG_COVER, FLAG_DEBUG, FLAG_DEDUP_COVER, FLAG_ENABLE_TUN,
+    FLAG_FAKE_COVER, FLAG_SANDBOX_NAMESPACE, FLAG_SANDBOX_SETUID,
+    FLAG_THREADED,
     CallResult, Env, ExecResult, ExecutorFailure, Gate,
 )

@@ -38,6 +38,7 @@ FLAG_DEDUP_COVER = 1 << 4
 FLAG_SANDBOX_SETUID = 1 << 5
 FLAG_SANDBOX_NAMESPACE = 1 << 6
 FLAG_FAKE_COVER = 1 << 7
+FLAG_ENABLE_TUN = 1 << 8
 
 # executor exit statuses (ref common.h:46-48)
 STATUS_OK = 0

@@ -386,7 +386,8 @@ class Compiler:
         for sdef in self.desc.syscalls:
             call_name = sdef.name.split("$", 1)[0]
             if call_name.startswith("syz_"):
-                nr = pseudo_nr.setdefault(call_name, T.PSEUDO_NR_BASE + 1 + len(pseudo_nr))
+                nr = T.PSEUDO_NRS.get(call_name) or pseudo_nr.setdefault(
+                    call_name, T.PSEUDO_NR_DYN_BASE + len(pseudo_nr))
             else:
                 nr = self.consts.get(f"__NR_{call_name}")
                 if nr is None:

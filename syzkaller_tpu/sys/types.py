@@ -349,6 +349,21 @@ class Syscall:
 
 PSEUDO_NR_BASE = 1_000_000
 
+# Fixed numbers for the pseudo-syscalls the native executor implements
+# (mirrored by the switch in native/executor.cc — keep in sync).  Pinning
+# them here makes the Python↔C contract independent of description file
+# order; syz_* names outside this table (the syz_probe* fixture family)
+# get dynamic numbers from PSEUDO_NR_DYN_BASE up and execute as no-ops.
+PSEUDO_NRS = {
+    "syz_open_dev": PSEUDO_NR_BASE + 1,
+    "syz_open_pts": PSEUDO_NR_BASE + 2,
+    "syz_fuse_mount": PSEUDO_NR_BASE + 3,
+    "syz_fuseblk_mount": PSEUDO_NR_BASE + 4,
+    "syz_emit_ethernet": PSEUDO_NR_BASE + 5,
+    "syz_kvm_setup_cpu": PSEUDO_NR_BASE + 6,
+}
+PSEUDO_NR_DYN_BASE = PSEUDO_NR_BASE + 100
+
 
 def foreach_type(call: Syscall, fn) -> None:
     """Visit every type reachable from a call signature (incl. ret).

@@ -139,10 +139,14 @@ def test_missing_const_skips_call():
 
 
 def test_pseudo_numbering():
-    c = compile_snippet("syz_a()\nsyz_b()\nsyz_a$v()\n")
+    # executor-implemented helpers have pinned numbers; unknown syz_*
+    # (fixture family) allocate dynamically from PSEUDO_NR_DYN_BASE
+    c = compile_snippet("syz_a()\nsyz_b()\nsyz_a$v()\nsyz_open_pts$x(m fd)\n"
+                        "resource fd[int32]\n")
     nrs = {s.name: s.nr for s in c.syscalls}
-    assert nrs["syz_a"] == nrs["syz_a$v"] == T.PSEUDO_NR_BASE + 1
-    assert nrs["syz_b"] == T.PSEUDO_NR_BASE + 2
+    assert nrs["syz_a"] == nrs["syz_a$v"] == T.PSEUDO_NR_DYN_BASE
+    assert nrs["syz_b"] == T.PSEUDO_NR_DYN_BASE + 1
+    assert nrs["syz_open_pts$x"] == T.PSEUDO_NRS["syz_open_pts"]
 
 
 def test_buffer_kinds():
