@@ -35,8 +35,16 @@ class LocalInstance(base.Instance):
 
     def run(self, command: str, timeout: float) -> base.RunHandle:
         merger = base.OutputMerger()
+        # The fuzzer is launched as `python -m syzkaller_tpu...` with the
+        # instance workdir as cwd; make the package importable from there
+        # regardless of how the test process itself found it.
+        pkg_root = os.path.dirname(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))))
+        env = dict(os.environ)
+        env["PYTHONPATH"] = pkg_root + (
+            os.pathsep + env["PYTHONPATH"] if env.get("PYTHONPATH") else "")
         proc = subprocess.Popen(
-            command, shell=True, cwd=self.workdir,
+            command, shell=True, cwd=self.workdir, env=env,
             stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
             start_new_session=True)
         self._procs.append(proc)
