@@ -334,8 +334,13 @@ class CoverageEngine:
         def _popcount(mat):
             return popcount_rows(mat)
 
+        @jax.jit
+        def _pack(pc_idx, valid):
+            return pack_pcs(pc_idx, valid, npcs)
+
         self._random_bits_fn = _random_bits
         self._popcount_fn = _popcount
+        self._pack_fn = _pack
         self._admit_selected_fn = _admit_selected
         self._update_fn = _update
         self._or_rows_fn = _or_rows
@@ -393,6 +398,11 @@ class CoverageEngine:
         self.corpus_len += n
         return idx
 
+    def pack_batch(self, pc_idx, valid) -> jax.Array:
+        """(B, K) indices + mask → (B, W) device bitmaps (no state)."""
+        return self._pack_fn(jnp.asarray(pc_idx, jnp.int32),
+                             jnp.asarray(valid, jnp.bool_))
+
     @_locked
     def triage_diff(self, call_ids, pc_idx, valid):
         """Diff vs corpus cover minus flakes (ref triageInput
@@ -408,12 +418,21 @@ class CoverageEngine:
         self.flakes = self._or_rows_fn(self.flakes, call_ids, bitmaps)
 
     @_locked
-    def merge_corpus(self, call_ids, bitmaps) -> "np.ndarray | None":
+    def merge_corpus(self, call_ids, bitmaps,
+                     cover_only_when_full: bool = False
+                     ) -> "np.ndarray | None":
         """Admit execs into corpus cover + the corpus signal matrix.
-        Returns indices assigned (None if corpus is full — nothing is
-        merged then, so the coverage stays re-discoverable later)."""
+        Returns indices assigned.  When the matrix is full: with
+        cover_only_when_full the cover bitmap still merges (callers that
+        keep the program anyway need the gate to stay truthful) and None
+        is returned; otherwise nothing merges, so the coverage stays
+        re-discoverable later (manager drop-the-input semantics)."""
         n = int(bitmaps.shape[0])
         if self.corpus_len + n > self.cap:
+            if cover_only_when_full:
+                call_ids = jnp.asarray(call_ids, jnp.int32)
+                self.corpus_cover = self._or_rows_fn(
+                    self.corpus_cover, call_ids, bitmaps)
             return None
         call_ids = jnp.asarray(call_ids, jnp.int32)
         self.corpus_cover = self._or_rows_fn(self.corpus_cover, call_ids, bitmaps)

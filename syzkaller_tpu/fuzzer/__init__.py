@@ -1,4 +1,5 @@
 """The fuzzing engine: executor-facing loop around the device core."""
 
 from syzkaller_tpu.fuzzer.device_ct import DeviceChoiceTable  # noqa: F401
+from syzkaller_tpu.fuzzer.device_signal import DeviceSignal  # noqa: F401
 from syzkaller_tpu.fuzzer.pcmap import PcMap  # noqa: F401

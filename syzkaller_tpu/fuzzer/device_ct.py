@@ -28,14 +28,18 @@ class DeviceChoiceTable:
         """ONE device call draws `per_row` decisions for every possible
         previous call (plus the no-context row): (ncalls+1)*per_row
         categorical draws, amortizing tunnel latency over thousands of
-        choose() calls."""
+        choose() calls.  Rows that still hold unused draws keep them
+        (topped up, never discarded) so hot rows draining doesn't throw
+        away the cold rows' cache."""
         n = self.engine.ncalls
         prev = np.repeat(np.arange(-1, n, dtype=np.int32), self.per_row)
         draws = self.engine.sample_next_calls(prev)
         for row in range(-1, n):
             lo = (row + 1) * self.per_row
-            self._cache[row] = deque(
-                int(x) for x in draws[lo: lo + self.per_row])
+            q = self._cache.setdefault(row, deque())
+            need = self.per_row - len(q)
+            if need > 0:
+                q.extend(int(x) for x in draws[lo: lo + need])
 
     def choose(self, r, prev_call_id: int = -1) -> int:
         with self._mu:

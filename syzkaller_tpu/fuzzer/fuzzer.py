@@ -54,7 +54,9 @@ class Fuzzer:
     def __init__(self, name: str, manager_addr: str, procs: int = 1,
                  descriptions: str = "all", flags: "int | None" = None,
                  output_mode: str = "none", leak: bool = False,
-                 table=None, seed: int = 0):
+                 table=None, seed: int = 0, use_device: bool = False,
+                 npcs: int = 1 << 16, flush_batch: int = 32,
+                 corpus_cap: int = 1 << 14):
         self.name = name
         self.client = rpc.RpcClient(manager_addr)
         self.procs = procs
@@ -65,6 +67,26 @@ class Fuzzer:
                       ipc.FLAG_COVER | ipc.FLAG_DEDUP_COVER | ipc.FLAG_FAKE_COVER)
         self.leak = leak and os.path.exists("/sys/kernel/debug/kmemleak")
         self.seed = seed
+        # Device-resident signal path (VERDICT r1 #3): per-exec diffs,
+        # flakes and corpus membership run on the CoverageEngine; falls
+        # back to the numpy sorted-set path when JAX is unavailable.
+        self.signal = None
+        if use_device:
+            try:
+                # jax logs platform chatter at WARNING; our stdout/stderr
+                # is a VM console stream scanned for kernel oopses
+                import logging
+                logging.getLogger("jax._src.xla_bridge").setLevel(
+                    logging.ERROR)
+                from syzkaller_tpu.fuzzer.device_signal import DeviceSignal
+                self.signal = DeviceSignal(
+                    ncalls=self.table.count, npcs=npcs,
+                    flush_batch=flush_batch, corpus_cap=corpus_cap,
+                    seed=seed)
+            except Exception as e:  # no jax / no backend: degrade to host
+                log.logf(0, "device signal unavailable (%s); using host sets", e)
+        # (prog, call_index, canonical cover) awaiting a device verdict
+        self._pending_sig: list[tuple] = []
 
         n = self.table.count
         self.max_cover: list[np.ndarray] = [np.zeros(0, np.uint32)] * n
@@ -122,8 +144,16 @@ class Fuzzer:
             log.fatalf("no enabled calls after closure")
         if prios is None:
             prios = P.calculate_priorities(self.table)
-        self.ct = P.ChoiceTable(prios, set(self.enabled_ids),
-                                ncalls=self.table.count)
+        if self.signal is not None:
+            # Batched categorical draws on device replace the per-call
+            # prefix-sum binary search (ref prog/prio.go:230-249).
+            from syzkaller_tpu.fuzzer.device_ct import DeviceChoiceTable
+            self.signal.engine.set_priorities(prios)
+            self.signal.engine.set_enabled(self.enabled_ids)
+            self.ct = DeviceChoiceTable(self.signal.engine)
+        else:
+            self.ct = P.ChoiceTable(prios, set(self.enabled_ids),
+                                    ncalls=self.table.count)
 
     # -- signal helpers ----------------------------------------------------
 
@@ -160,6 +190,21 @@ class Fuzzer:
         return None
 
     def check_new_signal(self, p: M.Prog, res: ipc.ExecResult) -> None:
+        if self.signal is not None:
+            # Device path: buffer exec calls and flush them through one
+            # fixed-shape update_batch step (diff vs max cover + merge,
+            # in-batch dedup) — the BASELINE hot loop on device.  The
+            # prog is immutable once executed, so no clone here: items
+            # that get a new-signal verdict are cloned at flush time.
+            items = [(p, c.index, sets.canonicalize(c.cover))
+                     for c in res.calls
+                     if c.index < len(p.calls) and len(c.cover)]
+            with self._mu:
+                self._pending_sig.extend(items)
+                full = len(self._pending_sig) >= self.signal.B
+            if full:
+                self.flush_signal()
+            return
         for c in res.calls:
             if c.index >= len(p.calls) or not len(c.cover):
                 continue
@@ -174,15 +219,49 @@ class Fuzzer:
                     prog=M.clone_prog(p), call_index=c.index,
                     cover=sets.canonicalize(c.cover)))
 
+    def flush_signal(self, force: bool = False) -> None:
+        """Drain pending exec covers through device update steps; execs
+        with new signal enter the triage queue (ref fuzzer.go:460-478)."""
+        if self.signal is None:
+            return
+        while True:
+            with self._mu:
+                if not self._pending_sig:
+                    return
+                if len(self._pending_sig) < self.signal.B and not force:
+                    return
+                batch = self._pending_sig[: self.signal.B]
+                self._pending_sig = self._pending_sig[self.signal.B:]
+            entries = [(p.calls[ci].meta.id, cov) for p, ci, cov in batch]
+            has_new = self.signal.check_batch(entries)
+            with self._mu:
+                for (p, ci, cov), new in zip(batch, has_new):
+                    if new:
+                        self.triage_q.append(TriageItem(
+                            prog=M.clone_prog(p), call_index=ci, cover=cov))
+
     # -- triage (ref fuzzer.go:377-454) ------------------------------------
+
+    def _triage_new(self, call_id: int, cover: np.ndarray) -> np.ndarray:
+        """cover − corpus_cover[call] − flakes[call] (ref fuzzer.go:384)."""
+        if self.signal is not None:
+            return self.signal.triage_new(call_id, cover)
+        with self._mu:
+            return sets.difference(
+                sets.difference(cover, self.corpus_cover[call_id]),
+                self.flakes[call_id])
+
+    def _add_flakes(self, call_id: int, pcs: np.ndarray) -> None:
+        if self.signal is not None:
+            self.signal.add_flakes(call_id, pcs)
+            return
+        with self._mu:
+            self.flakes[call_id] = sets.union(self.flakes[call_id], pcs)
 
     def triage(self, env: ipc.Env, item: TriageItem, rand: P.Rand,
                pid: int) -> None:
         call_id = item.prog.calls[item.call_index].meta.id
-        with self._mu:
-            new_cover = sets.difference(
-                sets.difference(item.cover, self.corpus_cover[call_id]),
-                self.flakes[call_id])
+        new_cover = self._triage_new(call_id, item.cover)
         if len(new_cover) == 0 and not item.from_candidate:
             return
         # 3× re-execution: intersect stable cover, accumulate flakes
@@ -196,15 +275,10 @@ class Fuzzer:
             if got is None or not len(got.cover):
                 return  # didn't reproduce at all
             cov = sets.canonicalize(got.cover)
-            with self._mu:
-                self.flakes[call_id] = sets.union(
-                    self.flakes[call_id],
-                    sets.symmetric_difference(min_cover, cov))
+            self._add_flakes(call_id,
+                             sets.symmetric_difference(min_cover, cov))
             min_cover = sets.intersection(min_cover, cov)
-        with self._mu:
-            stable_new = sets.difference(
-                sets.difference(min_cover, self.corpus_cover[call_id]),
-                self.flakes[call_id])
+        stable_new = self._triage_new(call_id, min_cover)
         if len(stable_new) == 0 and not item.from_candidate:
             return
 
@@ -220,9 +294,12 @@ class Fuzzer:
             self.corpus_hashes.add(h)
             self.corpus.append(item.prog)
             cid = item.prog.calls[item.call_index].meta.id
-            self.corpus_cover[cid] = sets.union(self.corpus_cover[cid],
-                                                min_cover)
+            if self.signal is None:
+                self.corpus_cover[cid] = sets.union(self.corpus_cover[cid],
+                                                    min_cover)
             self.stats["new inputs"] += 1
+        if self.signal is not None:
+            self.signal.merge_corpus(cid, min_cover)
         self.client.call("Manager.NewInput", {
             "name": self.name,
             "call": item.prog.calls[item.call_index].meta.name,
@@ -254,6 +331,10 @@ class Fuzzer:
         gate = self.gate
         try:
             while not self._stop:
+                if self.signal is not None and rand.exhausted():
+                    # device PRNG feeds gen/mutation draws: one jit call
+                    # per ~8k decisions (SURVEY §7 batching economics)
+                    rand.refill(self.signal.engine.random_words(1 << 13))
                 item = None
                 candidate = None
                 with self._mu:
@@ -314,6 +395,20 @@ class Fuzzer:
         res = self.execute(env, p, "exec candidate", pid)
         if res is None:
             return
+        if self.signal is not None:
+            calls = [c for c in res.calls
+                     if c.index < len(p.calls) and len(c.cover)]
+            for lo in range(0, len(calls), self.signal.B):
+                chunk = calls[lo: lo + self.signal.B]
+                has_new = self.signal.check_batch(
+                    [(p.calls[c.index].meta.id, c.cover) for c in chunk])
+                for c, new in zip(chunk, has_new):
+                    if new:
+                        self.triage_q.append(TriageItem(
+                            prog=M.clone_prog(p), call_index=c.index,
+                            cover=sets.canonicalize(c.cover),
+                            from_candidate=True, minimized=minimized))
+            return
         for c in res.calls:
             if c.index < len(p.calls) and len(c.cover):
                 call_id = p.calls[c.index].meta.id
@@ -346,6 +441,9 @@ class Fuzzer:
     # -- poll loop (ref fuzzer.go:235-305) ---------------------------------
 
     def poll_once(self) -> None:
+        # periodic flush so low-throughput runs don't strand signal in
+        # the pending buffer past the batch boundary
+        self.flush_signal(force=True)
         with self._mu:
             stats = dict(self.stats)
             for k in self.stats:
@@ -373,6 +471,19 @@ class Fuzzer:
             return
         call_id = p.calls[ci].meta.id
         cover = sets.canonicalize(np.array(inp.get("cover", []), np.uint32))
+        if self.signal is not None:
+            if len(self.signal.triage_new(call_id, cover)) == 0:
+                return
+            data = P.serialize(p)
+            h = __import__("hashlib").sha1(data).digest()
+            with self._mu:
+                if h in self.corpus_hashes:
+                    return
+                self.corpus_hashes.add(h)
+                self.corpus.append(p)
+            self.signal.merge_corpus(call_id, cover)
+            self.signal.merge_max(call_id, cover)
+            return
         with self._mu:
             diff = sets.difference(cover, self.corpus_cover[call_id])
             if len(diff) == 0:
@@ -408,6 +519,7 @@ class Fuzzer:
             self._stop = True
             for t in threads:
                 t.join(timeout=5.0)
+            self.flush_signal(force=True)
 
     def stop(self) -> None:
         self._stop = True
@@ -428,6 +540,12 @@ def main(argv=None):
                     choices=["none", "setuid", "namespace"])
     ap.add_argument("-leak", action="store_true")
     ap.add_argument("-seed", type=int, default=0)
+    ap.add_argument("-device", action="store_true",
+                    help="run signal diffs/sampling on the JAX device")
+    ap.add_argument("-npcs", type=int, default=1 << 16)
+    ap.add_argument("-flush-batch", type=int, default=32, dest="flush_batch")
+    ap.add_argument("-corpus-cap", type=int, default=1 << 14,
+                    dest="corpus_cap")
     ap.add_argument("-v", type=int, default=0)
     args = ap.parse_args(argv)
     log.set_verbosity(args.v)
@@ -446,7 +564,9 @@ def main(argv=None):
 
     f = Fuzzer(name=args.name, manager_addr=args.manager, procs=args.procs,
                descriptions=args.descriptions, flags=flags,
-               output_mode=args.output, leak=args.leak, seed=args.seed)
+               output_mode=args.output, leak=args.leak, seed=args.seed,
+               use_device=args.device, npcs=args.npcs,
+               flush_batch=args.flush_batch, corpus_cap=args.corpus_cap)
 
     def on_sigint(sig, frame):
         # GCE preemption path (ref fuzzer.go:102-109, vm/vm.go:118-120)

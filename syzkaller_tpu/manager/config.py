@@ -43,6 +43,7 @@ class Config:
     npcs: int = 1 << 16                # coverage bitmap size (PC axis)
     corpus_cap: int = 1 << 14
     flush_batch: int = 256
+    fuzzer_device: bool = False        # fuzzers run signal diffs on device
     # VM-type specific (qemu)
     kernel: str = ""
     image: str = ""

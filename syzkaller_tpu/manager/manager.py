@@ -354,6 +354,12 @@ class Manager:
             a.append("-real-cover")
         if self.cfg.leak:
             a.append("-leak")
+        if self.cfg.fuzzer_device:
+            # per-VM fuzzer batches are a fraction of the manager's own
+            # admission batch: one VM sees 1/count of the exec stream
+            a += ["-device", "-npcs", str(self.cfg.npcs),
+                  "-flush-batch", str(max(8, self.cfg.flush_batch // 8)),
+                  "-corpus-cap", str(self.cfg.corpus_cap)]
         return " ".join(shlex.quote(x) for x in a)
 
     def vm_loop(self, index: int) -> None:

@@ -43,6 +43,10 @@ class Rand:
         self._pool = np.asarray(words, dtype=np.uint64)
         self._pos = 0
 
+    def exhausted(self) -> bool:
+        """True when the device pool has drained (time to refill)."""
+        return self._pos >= len(self._pool)
+
     def rand64(self) -> int:
         if self._pos < len(self._pool):
             v = int(self._pool[self._pos])

@@ -63,7 +63,11 @@ OOPSES: list[Oops] = [
         OopsFormat(_compile(r"BUG: non-zero nr_pmds on freeing mm"), "BUG: non-zero nr_pmds on freeing mm"),
         OopsFormat(_compile(r"BUG: workqueue lockup"), "BUG: workqueue lockup"),
     ]),
-    Oops(b"WARNING:", [
+    # trailing space: kernel warnings are "WARNING: CPU:..."/"WARNING:
+    # possible..."; Python logging emits "WARNING:2026-..." (no space),
+    # which must not read as a guest oops when user tooling logs inside
+    # the VM console stream
+    Oops(b"WARNING: ", [
         OopsFormat(_compile(r"WARNING: .* at {{SRC}} {{FUNC}}"), "WARNING in {1}"),
         OopsFormat(_compile(r"WARNING: possible circular locking dependency detected"),
                    "possible deadlock"),

@@ -1,0 +1,152 @@
+"""DeviceSignal (the fuzzer's device-resident signal backend) vs the
+numpy sorted-set reference implementation, plus a live manager+fuzzer
+run with the device path enabled (VERDICT r1 item #3)."""
+
+import os
+import threading
+
+import numpy as np
+import pytest
+
+from syzkaller_tpu.cover import sets
+from syzkaller_tpu.fuzzer.device_signal import DeviceSignal
+
+
+NCALLS = 8
+NPCS = 1 << 12
+
+
+@pytest.fixture
+def sig():
+    return DeviceSignal(ncalls=NCALLS, npcs=NPCS, flush_batch=8,
+                        max_pcs=64, corpus_cap=256)
+
+
+def rand_cover(rng, n=24):
+    # raw "kernel PCs": arbitrary uint64 values, not bitmap indices
+    return (rng.integers(0, 1 << 48, size=n).astype(np.uint64)
+            | np.uint64(0xFFFF000000000000))
+
+
+def test_check_batch_matches_host_sets(sig, rng):
+    max_cover = [np.zeros(0, np.uint64) for _ in range(NCALLS)]
+    for _ in range(20):
+        n = int(rng.integers(1, sig.B + 1))
+        entries = []
+        expect = []
+        for _ in range(n):
+            cid = int(rng.integers(0, NCALLS))
+            # half the time replay an old-ish cover to get negatives
+            cov = rand_cover(rng, 16)
+            if len(max_cover[cid]) and rng.random() < 0.5:
+                cov = max_cover[cid][: 16].copy()
+            entries.append((cid, cov))
+        # host reference, sequential per exec (in-batch dedup semantics)
+        for cid, cov in entries:
+            c = sets.canonicalize(cov)
+            diff = sets.difference(c, max_cover[cid])
+            expect.append(len(diff) > 0)
+            max_cover[cid] = sets.union(max_cover[cid], c)
+        got = sig.check_batch(entries)
+        assert list(got) == expect
+
+
+def test_triage_new_and_flakes(sig, rng):
+    cid = 3
+    cov = sets.canonicalize(rand_cover(rng, 32))
+    # nothing in corpus cover yet: everything is new
+    new = sig.triage_new(cid, cov)
+    assert sets.difference(cov, new).size == 0 and len(new) == len(cov)
+    # admit half into the corpus; only the other half stays new
+    half, rest = cov[: len(cov) // 2], cov[len(cov) // 2:]
+    sig.merge_corpus(cid, half)
+    new = sig.triage_new(cid, cov)
+    assert sorted(new) == sorted(rest)
+    # flake two of the remaining PCs: they disappear from the verdict
+    sig.add_flakes(cid, rest[:2])
+    new = sig.triage_new(cid, cov)
+    assert sorted(new) == sorted(rest[2:])
+    # a different call id is unaffected
+    assert len(sig.triage_new(cid + 1, cov)) == len(cov)
+
+
+def test_long_covers_span_rows(sig, rng):
+    """A cover longer than max_pcs (K=64 here) must not be truncated or
+    crash: it spreads over multiple device rows of the same call."""
+    cid = 2
+    big = sets.canonicalize(rand_cover(rng, 5 * 64 + 7))  # > 5 rows
+    new = sig.triage_new(cid, big)
+    assert len(new) == len(big)          # all new, none dropped
+    sig.merge_corpus(cid, big)
+    assert len(sig.triage_new(cid, big)) == 0   # every PC admitted
+    # check_batch dedups the long cover against itself across rows
+    assert list(sig.check_batch([(cid, big)])) == [True]
+    assert list(sig.check_batch([(cid, big)])) == [False]
+    # tail signal beyond the first row is detected as new
+    tail = np.concatenate([big[:80], rand_cover(rng, 4)])
+    got = sig.triage_new(cid, tail)
+    assert len(got) == 4
+
+
+def test_merge_corpus_full_still_merges_cover(rng):
+    sig = DeviceSignal(ncalls=4, npcs=1 << 12, flush_batch=4,
+                       max_pcs=32, corpus_cap=2)
+    c1, c2, c3 = (sets.canonicalize(rand_cover(rng, 8)) for _ in range(3))
+    sig.merge_corpus(0, c1)
+    sig.merge_corpus(0, c2)
+    sig.merge_corpus(0, c3)              # matrix full → cover-only merge
+    assert sig.stat_corpus_full == 1
+    assert len(sig.triage_new(0, c3)) == 0   # gate still truthful
+
+
+def test_merge_corpus_appends_device_rows(sig, rng):
+    before = sig.engine.corpus_len
+    sig.merge_corpus(1, sets.canonicalize(rand_cover(rng)))
+    sig.merge_corpus(2, sets.canonicalize(rand_cover(rng)))
+    assert sig.engine.corpus_len == before + 2
+    assert sig.engine.cover_counts().sum() > 0
+
+
+def test_check_batch_thread_safety(sig, rng):
+    covers = [rand_cover(rng, 8) for _ in range(64)]
+    errs = []
+
+    def worker(k):
+        try:
+            for i in range(16):
+                sig.check_batch([(k, covers[(k * 16 + i) % len(covers)])])
+                sig.triage_new(k, covers[i % len(covers)])
+        except Exception as e:  # pragma: no cover
+            errs.append(e)
+
+    ts = [threading.Thread(target=worker, args=(k,)) for k in range(4)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join()
+    assert not errs
+
+
+@pytest.mark.skipif(os.system("g++ --version > /dev/null 2>&1") != 0,
+                    reason="no g++")
+def test_fuzzer_device_integration(tmp_path):
+    """Manager + fuzzer subprocess with -device: the production hot loop
+    runs through CoverageEngine (update_batch / DeviceChoiceTable /
+    device-refilled Rand) and still finds + reports corpus inputs."""
+    from syzkaller_tpu.manager.config import Config
+    from syzkaller_tpu.manager.manager import Manager
+
+    cfg = Config(workdir=str(tmp_path / "workdir"), type="local", count=1,
+                 procs=2, descriptions="probe.txt", npcs=1 << 14,
+                 http="", corpus_cap=1 << 12, fuzzer_device=True)
+    mgr = Manager(cfg)
+    assert "-device" in mgr.fuzzer_cmdline(0, "127.0.0.1:1")
+    t = threading.Thread(target=mgr.run, kwargs={"duration": 30.0})
+    t.start()
+    t.join(timeout=90.0)
+    assert not t.is_alive()
+    with mgr._mu:
+        execs = mgr.stats.get("exec total", 0)
+        ncorpus = len(mgr.corpus)
+    assert execs > 20, f"only {execs} execs"
+    assert ncorpus > 0
