@@ -38,7 +38,9 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 
-def nwords_for(npcs: int, align: int = 8) -> int:
+def nwords_for(npcs: int, align: int = 64) -> int:
+    # 64-word alignment: pack_pcs factors words as (hi, 64-lo) for its
+    # MXU one-hot matmuls
     w = (npcs + 31) // 32
     return (w + align - 1) // align * align
 
@@ -47,21 +49,49 @@ def nwords_for(npcs: int, align: int = 8) -> int:
 # Pure jittable kernels (shapes static; engine closes over them).
 
 
-def pack_pcs(pc_idx: jax.Array, valid: jax.Array, npcs: int) -> jax.Array:
+def pack_pcs(pc_idx: jax.Array, valid: jax.Array, npcs: int,
+             assume_unique: bool = False) -> jax.Array:
     """(B, K) int32 PC indices + mask → (B, W) uint32 packed bitmaps.
-    Invalid/masked indices are routed out of range and dropped."""
-    B = pc_idx.shape[0]
+    Invalid/masked indices are dropped.
+
+    MXU formulation — no gather/scatter (measured at only ~120M random
+    elems/s on TPU, the old bottleneck): factor each word index as
+    (hi, lo) with 64 words per hi-group and split each word into 4 byte
+    planes, build two small one-hots, and let ONE batched bf16 matmul
+    accumulate the bits:  M[b,hi,col] = Σ_k onehot_hi × (onehot_col ·
+    2^bit_in_byte).  Byte sums ≤ 255 are exact in bf16/f32, so
+    recombining the 4 planes with integer shifts reproduces the exact
+    uint32 words.  Requires each row's indices to be unique (duplicate
+    bits would ADD) — per-exec covers are already sort-deduped by the
+    executor/PcMap; pass assume_unique=False to sort-dedup here."""
+    B, K = pc_idx.shape
     W = nwords_for(npcs)
-    # Route masked AND out-of-range indices past the padded bit width so
-    # mode="drop" really drops them (npcs itself can be a valid padding
-    # bit when npcs % (32*align) != 0).
+    HI, COL = W // 64, 256
     ok = valid & (pc_idx >= 0) & (pc_idx < npcs)
-    idx = jnp.where(ok, pc_idx, W * 32)
-    bits = jnp.zeros((B, W * 32), jnp.bool_)
-    bits = bits.at[jnp.arange(B)[:, None], idx].set(True, mode="drop")
-    lanes = bits.reshape(B, W, 32).astype(jnp.uint32)
-    weights = (jnp.uint32(1) << jnp.arange(32, dtype=jnp.uint32))
-    return (lanes * weights[None, None, :]).sum(axis=-1, dtype=jnp.uint32)
+    if assume_unique:
+        s = jnp.where(ok, pc_idx, jnp.int32(npcs))
+        keep = ok
+    else:
+        s = jnp.sort(jnp.where(ok, pc_idx, jnp.int32(npcs)), axis=1)
+        dup = jnp.concatenate(
+            [jnp.zeros((B, 1), bool), s[:, 1:] == s[:, :-1]], axis=1)
+        keep = (s < npcs) & ~dup
+    word = s >> 5
+    sub = s & 31
+    hi = word >> 6
+    col = (word & 63) * 4 + (sub >> 3)
+    bitv = (jnp.uint32(1) << (sub & 7).astype(jnp.uint32)).astype(jnp.bfloat16)
+    onehot_hi = ((hi[:, :, None] == jnp.arange(HI)[None, None, :])
+                 & keep[:, :, None])
+    onehot_col = jnp.where(
+        (col[:, :, None] == jnp.arange(COL)[None, None, :])
+        & keep[:, :, None], bitv[:, :, None], 0).astype(jnp.bfloat16)
+    M = jnp.einsum("bkh,bkc->bhc", onehot_hi.astype(jnp.bfloat16),
+                   onehot_col, preferred_element_type=jnp.float32)
+    planes = M.reshape(B, HI, 64, 4).astype(jnp.uint32)
+    words = (planes[..., 0] | (planes[..., 1] << 8)
+             | (planes[..., 2] << 16) | (planes[..., 3] << 24))
+    return words.reshape(B, W)
 
 
 def scatter_or(base: jax.Array, call_ids: jax.Array,
@@ -79,20 +109,51 @@ def scatter_or(base: jax.Array, call_ids: jax.Array,
 
 def diff_merge(base: jax.Array, call_ids: jax.Array, bitmaps: jax.Array
                ) -> tuple[jax.Array, jax.Array, jax.Array]:
-    """Sequential diff-then-merge over the batch: row i's new-signal is
-    computed against base ∪ rows[0..i) of the same call, so two identical
+    """Diff-then-merge over the batch: row i's new-signal is computed
+    against base ∪ rows[0..i) of the same call, so two identical
     new-coverage execs in one batch yield exactly one has_new verdict
     (matching the reference, which processes execs one at a time).
+
+    Fully vectorized: stable-sort rows by call id (runs become
+    contiguous), build the EXCLUSIVE per-segment prefix-OR with log2(B)
+    Hillis-Steele doubling passes over the (B, W) matrix, then one
+    row-gather of base and one scatter of each run's final OR.  The
+    previous per-row lax.scan serialized B tiny steps and dominated the
+    step time (~3ms at B=256); this is ~10 elementwise passes.
     Returns (merged base, (B, W) new bitmaps, (B,) has_new)."""
+    B, W = bitmaps.shape
+    order = jnp.argsort(call_ids, stable=True)
+    cid_s = call_ids[order]
+    bm_s = bitmaps[order]
 
-    def body(acc, x):
-        cid, bm = x
-        prev = acc[cid]
-        new = jnp.bitwise_and(bm, jnp.bitwise_not(prev))
-        acc = acc.at[cid].set(jnp.bitwise_or(prev, bm))
-        return acc, new
+    # pre_i = bm_{i-1} if same segment else 0; its inclusive segmented
+    # scan is exactly the exclusive prefix-OR of bm within the segment
+    same_prev = jnp.concatenate(
+        [jnp.zeros((1,), bool), cid_s[1:] == cid_s[:-1]])
+    pre = jnp.where(
+        same_prev[:, None],
+        jnp.concatenate([jnp.zeros((1, W), bm_s.dtype), bm_s[:-1]], axis=0),
+        jnp.uint32(0))
+    excl = pre
+    s = 1
+    while s < B:
+        shifted = jnp.concatenate(
+            [jnp.zeros((min(s, B), W), excl.dtype), excl[:-s]], axis=0)[:B]
+        same = jnp.concatenate(
+            [jnp.zeros((min(s, B),), bool), cid_s[s:] == cid_s[:-s]])[:B]
+        excl = jnp.where(same[:, None], jnp.bitwise_or(excl, shifted), excl)
+        s *= 2
 
-    merged, new = jax.lax.scan(body, base, (call_ids, bitmaps))
+    prev = jnp.bitwise_or(base[cid_s], excl)
+    new_s = jnp.bitwise_and(bm_s, jnp.bitwise_not(prev))
+    full = jnp.bitwise_or(prev, bm_s)
+    # one scatter per segment: the last row of each run holds base|seg-OR
+    last = jnp.concatenate([cid_s[1:] != cid_s[:-1], jnp.ones((1,), bool)])
+    idx = jnp.where(last, cid_s, base.shape[0])          # drop non-last
+    merged = base.at[idx].set(full, mode="drop")
+    # unsort the per-row outputs back to submission order
+    inv = jnp.argsort(order)
+    new = new_s[inv]
     return merged, new, jnp.any(new != 0, axis=-1)
 
 
@@ -126,6 +187,27 @@ def minimize_cover(corpus: jax.Array, active: jax.Array) -> jax.Array:
     return keep
 
 
+def minimize_cover_scan(corpus: jax.Array, active: jax.Array) -> jax.Array:
+    """Set-cover for large corpora (C ≳ 4k): visit rows in popcount-
+    descending order, keep a row iff it still contributes fresh bits.
+    One lax.scan of C tiny steps instead of O(kept) full argmax passes
+    over the (C, W) matrix — same first pick as exact greedy, a valid
+    cover always (any bit's first contributor in order is kept)."""
+    C, W = corpus.shape
+    sizes = jnp.where(active, popcount_rows(corpus), -1)
+    order = jnp.argsort(-sizes)
+
+    def body(covered, i):
+        row = corpus[i]
+        fresh = jnp.any(jnp.bitwise_and(row, jnp.bitwise_not(covered)) != 0)
+        keep_i = fresh & active[i]
+        covered = jnp.where(keep_i, jnp.bitwise_or(covered, row), covered)
+        return covered, keep_i
+
+    _, keep_perm = jax.lax.scan(body, jnp.zeros((W,), jnp.uint32), order)
+    return jnp.zeros((C,), jnp.bool_).at[order].set(keep_perm)
+
+
 def sample_calls(key: jax.Array, probs: jax.Array, prev: jax.Array,
                  enabled: jax.Array) -> jax.Array:
     """Batched ChoiceTable draw: (B,) prev call ids (-1 = no context) →
@@ -154,13 +236,13 @@ def normalize_prios(prios: jax.Array) -> jax.Array:
 
 def fuzz_step(max_cover: jax.Array, prios: jax.Array, enabled: jax.Array,
               key: jax.Array, call_ids: jax.Array, pc_idx: jax.Array,
-              valid: jax.Array, npcs: int):
+              valid: jax.Array, npcs: int, assume_unique: bool = False):
     """The fused per-batch device step — the framework's 'forward pass':
     B execs' raw KCOV indices in → per-exec new-signal verdicts, merged
     max cover, and the next batch of ChoiceTable decisions out.  One jit
     call covers what the reference does per-exec in cover.Difference +
     cover.Union + prio.Choose (fuzzer.go:460-478, prio.go:230-249)."""
-    bitmaps = pack_pcs(pc_idx, valid, npcs)
+    bitmaps = pack_pcs(pc_idx, valid, npcs, assume_unique=assume_unique)
     merged, new, has_new = diff_merge(max_cover, call_ids, bitmaps)
     next_calls = sample_calls(key, prios, call_ids, enabled)
     return merged, new, has_new, next_calls
@@ -261,7 +343,8 @@ class CoverageEngine:
 
         @functools.partial(jax.jit, donate_argnums=(0,))
         def _update(max_cover, call_ids, pc_idx, valid):
-            bitmaps = pack_pcs(pc_idx, valid, npcs)
+            # PcMap.map_batch guarantees unique indices per row
+            bitmaps = pack_pcs(pc_idx, valid, npcs, assume_unique=True)
             merged, new, has_new = diff_merge(max_cover, call_ids, bitmaps)
             return merged, new, has_new, bitmaps
 
@@ -271,7 +354,7 @@ class CoverageEngine:
 
         @jax.jit
         def _diff_vs(base, call_ids, pc_idx, valid, flakes):
-            bitmaps = pack_pcs(pc_idx, valid, npcs)
+            bitmaps = pack_pcs(pc_idx, valid, npcs, assume_unique=True)
             prev = base[call_ids]
             fl = flakes[call_ids]
             new = jnp.bitwise_and(bitmaps,
@@ -302,6 +385,17 @@ class CoverageEngine:
         @jax.jit
         def _minimize(corpus_mat, active):
             return minimize_cover(corpus_mat, active)
+
+        @jax.jit
+        def _minimize_scan(corpus_mat, active):
+            return minimize_cover_scan(corpus_mat, active)
+
+        @functools.partial(jax.jit, static_argnums=(2,))
+        def _sample_rows(key, weights, n):
+            logits = jnp.where(weights > 0, jnp.log(weights.astype(
+                jnp.float32)), -jnp.inf)
+            return jax.random.categorical(key, logits[None, :], axis=-1,
+                                          shape=(1, n))[0]
 
         @functools.partial(jax.jit, donate_argnums=(0,))
         def _compact(corpus_mat, keep_mask, corpus_call):
@@ -336,7 +430,7 @@ class CoverageEngine:
 
         @jax.jit
         def _pack(pc_idx, valid):
-            return pack_pcs(pc_idx, valid, npcs)
+            return pack_pcs(pc_idx, valid, npcs, assume_unique=True)
 
         self._random_bits_fn = _random_bits
         self._popcount_fn = _popcount
@@ -347,6 +441,8 @@ class CoverageEngine:
         self._diff_vs_fn = _diff_vs
         self._admit_fn = _admit
         self._minimize_fn = _minimize
+        self._minimize_scan_fn = _minimize_scan
+        self._sample_rows_fn = _sample_rows
         self._compact_fn = _compact
         self._sample_fn = _sample
         self._prio_update_fn = _prio_update
@@ -444,13 +540,31 @@ class CoverageEngine:
         self.corpus_len += n
         return idx
 
+    # above this row count the exact greedy's per-pick argmax passes over
+    # the whole (C, W) matrix dominate; switch to the single-scan cover
+    MINIMIZE_SCAN_THRESHOLD = 4096
+
     @_locked
     def minimize_corpus(self) -> np.ndarray:
         """(cap,) keep mask over the admitted corpus rows."""
         active = np.zeros((self.cap,), bool)
         active[: self.corpus_len] = True
-        keep = self._minimize_fn(self.corpus_mat, jnp.asarray(active))
+        fn = (self._minimize_scan_fn
+              if self.corpus_len > self.MINIMIZE_SCAN_THRESHOLD
+              else self._minimize_fn)
+        keep = fn(self.corpus_mat, jnp.asarray(active))
         return np.asarray(keep)
+
+    def sample_corpus_rows(self, n: int) -> np.ndarray:
+        """Batched weighted draw of corpus rows (which programs to
+        mutate): categorical over per-row signal popcounts — the device
+        analog of corpus[rnd] picks, biased toward signal-rich inputs."""
+        if self.corpus_len == 0:
+            return np.zeros((0,), np.int64)
+        with self._state_mu:
+            weights = self._popcount_fn(self.corpus_mat)
+        rows = np.asarray(self._sample_rows_fn(self._next_key(), weights, n))
+        return np.clip(rows, 0, max(self.corpus_len - 1, 0))
 
     @_locked
     def compact_corpus(self, keep_mask: np.ndarray) -> dict[int, int]:
@@ -507,12 +621,32 @@ class CoverageEngine:
 
     @_locked
     def cover_counts(self) -> np.ndarray:
-        """(ncalls,) covered-PC counts (for stats/UI)."""
+        """(ncalls,) corpus-covered-PC counts (for stats/UI)."""
         return np.asarray(self._popcount_fn(self.corpus_cover))
 
     @_locked
-    def max_cover_pcs(self, call_id: int) -> np.ndarray:
-        """Unpack one call's max-cover bitmap to sorted PC indices."""
-        row = np.asarray(self.max_cover[call_id])
+    def max_cover_counts(self) -> np.ndarray:
+        """(ncalls,) ever-seen-PC counts (max cover, for the /cover UI)."""
+        return np.asarray(self._popcount_fn(self.max_cover))
+
+    @_locked
+    def covered_indices(self, corpus: bool = True) -> np.ndarray:
+        """Sorted bitmap indices covered by ANY call — the input to the
+        line-coverage report (union over the call axis).  Defaults to
+        corpus cover: that is the state the manager's admission path
+        maintains (max cover is the fuzzer-side fast gate)."""
+        mat = self.corpus_cover if corpus else self.max_cover
+        union = np.bitwise_or.reduce(np.asarray(mat), axis=0)
+        bits = np.unpackbits(union.view(np.uint8), bitorder="little")
+        return np.nonzero(bits)[0].astype(np.int64)
+
+    @_locked
+    def cover_pcs(self, call_id: int, corpus: bool = True) -> np.ndarray:
+        """Unpack one call's cover bitmap to sorted PC indices."""
+        mat = self.corpus_cover if corpus else self.max_cover
+        row = np.asarray(mat[call_id])
         bits = np.unpackbits(row.view(np.uint8), bitorder="little")
         return np.nonzero(bits)[0].astype(np.uint32)
+
+    def max_cover_pcs(self, call_id: int) -> np.ndarray:
+        return self.cover_pcs(call_id, corpus=False)

@@ -20,8 +20,6 @@ truncation up to B*K PCs per cover, chunked loops beyond.
 
 from __future__ import annotations
 
-import threading
-
 import numpy as np
 
 from syzkaller_tpu.cover import sets
@@ -43,7 +41,6 @@ class DeviceSignal:
         self.pcmap = PcMap(npcs)
         self.B = flush_batch
         self.K = max_pcs
-        self._mu = threading.Lock()
         self.stat_corpus_full = 0
 
     # -- mapping helpers ---------------------------------------------------
@@ -52,36 +49,28 @@ class DeviceSignal:
         """Canonicalized covers → fixed-shape (B, K) index rows + mask,
         spreading covers longer than K over several rows.  Returns
         (idx, valid, owner) where owner[r] = source cover of row r
-        (-1 = padding).  Padding to the fixed batch keeps every call on
-        the same compiled step."""
+        (-1 = padding).  The mask comes from map_batch itself — it can
+        compact rows when hash-overflow collisions dedup, so recomputing
+        counts from cover lengths would mark stale slots valid."""
         idx_rows, owners = [], []
-        with self._mu:
-            for i, cov in enumerate(covers):
-                mapped, _ = self.pcmap.map_batch(
-                    [cov[lo: lo + self.K] for lo in range(0, max(len(cov), 1),
-                                                          self.K)], self.K)
-                for r, lo in enumerate(range(0, max(len(cov), 1), self.K)):
-                    idx_rows.append((mapped[r], min(len(cov) - lo, self.K)))
-                    owners.append(i)
+        for i, cov in enumerate(covers):
+            chunks = [cov[lo: lo + self.K]
+                      for lo in range(0, max(len(cov), 1), self.K)]
+            mapped, mvalid = self.pcmap.map_batch(chunks, self.K)
+            for r in range(len(chunks)):
+                idx_rows.append((mapped[r], mvalid[r]))
+                owners.append(i)
         # round the row count up to a multiple of the flush batch so the
         # number of distinct compiled shapes stays O(1) in steady state
         B = max(self.B, (len(idx_rows) + self.B - 1) // self.B * self.B)
         idx = np.zeros((B, self.K), np.int32)
         valid = np.zeros((B, self.K), bool)
         owner = np.full((B,), -1, np.int32)
-        for r, (row, n) in enumerate(idx_rows):
+        for r, (row, va) in enumerate(idx_rows):
             idx[r] = row
-            valid[r, :n] = True
+            valid[r] = va
             owner[r] = owners[r]
         return idx, valid, owner
-
-    def _row_mask(self, row_words: np.ndarray, idx: np.ndarray,
-                  valid: np.ndarray) -> np.ndarray:
-        """Which of the (K,) dense indices have their bit set in the
-        (W,) bitmap row — maps a device verdict back onto the caller's
-        own PC array without any reverse PC table."""
-        bits = (row_words[idx >> 5] >> (idx & 31)) & 1
-        return (bits != 0) & valid
 
     # -- hot path ----------------------------------------------------------
 
@@ -107,20 +96,20 @@ class DeviceSignal:
 
     def triage_new(self, call_id: int, cover: np.ndarray) -> np.ndarray:
         """Subset of `cover` new vs corpus cover minus flakes (ref
-        fuzzer.go:384-386) — the admission gate, device-evaluated."""
+        fuzzer.go:384-386) — the admission gate, device-evaluated.
+        Each PC's verdict is read through its OWN dense index, so
+        hash-overflow aliasing (two PCs sharing an index) degrades to a
+        shared verdict instead of misattributing positions."""
         cover = sets.canonicalize(cover)
         idx, valid, owner = self._map_rows([cover])
         call_ids = np.full((idx.shape[0],), call_id, np.int32)
         _has, new, _bm = self.engine.triage_diff(call_ids, idx, valid)
         new = np.asarray(new)
+        pc_idx = self.pcmap.indices_of(cover)
         keep = np.zeros((len(cover),), bool)
-        for r in range(idx.shape[0]):
-            if owner[r] != 0:
-                continue
-            mask = self._row_mask(new[r], idx[r], valid[r])
-            lo = r * self.K
-            n = int(valid[r].sum())
-            keep[lo: lo + n] = mask[:n]
+        for k, pidx in enumerate(pc_idx):
+            r = k // self.K                    # the chunk row holding it
+            keep[k] = (new[r][pidx >> 5] >> (pidx & 31)) & 1
         return cover[keep]
 
     def add_flakes(self, call_id: int, pcs: np.ndarray) -> None:

@@ -1,0 +1,316 @@
+"""ifuzz equivalent: mode-aware machine-code generation and mutation.
+
+Capability parity with reference ifuzz/ (ifuzz.go:16-57 modes + opcode
+metadata, encode.go/decode.go, pseudo.go:10-50 pseudo-op sequences):
+TEXT buffer args become valid-ish instruction streams instead of random
+bytes, unlocking KVM guest fuzzing (`syz_kvm_setup_cpu` text payloads).
+
+Four x86 modes (real16/prot16/prot32/long64) share one curated table
+(insns.py) with exact ModRM/SIB/displacement/immediate length rules, so
+`insn_len` decodes exactly what `gen_insn` emits — the roundtrip
+property the tests pin.  ARM64 text is 4-byte words from a small
+pattern set (the reference's snapshot has no arm64 table either).
+"""
+
+from __future__ import annotations
+
+from syzkaller_tpu.ifuzz.insns import (
+    ALL, IMM_OPSIZE, IMM_OPSIZE64, LONG64, NOT64, PROT16, PROT32, REAL16,
+    Insn, TABLE, by_mode, opcode_index)
+
+MODES = (REAL16, PROT16, PROT32, LONG64)
+
+_PREFIXES = frozenset(
+    (0x26, 0x2E, 0x36, 0x3E, 0x64, 0x65, 0x66, 0x67, 0xF0, 0xF2, 0xF3))
+_SEG_PREFIXES = (0x26, 0x2E, 0x36, 0x3E, 0x64, 0x65)
+# CR/DR moves treat ModRM as register-only: no SIB/disp whatever mod says
+_REGONLY_OPS = (b"\x0f\x20", b"\x0f\x21", b"\x0f\x22", b"\x0f\x23")
+# group-opcode system forms are encoded memory-only so the /digit space
+# never collides with the explicit 3-byte forms (0f 01 f8 swapgs etc.)
+_MEM_ONLY_OPS = (b"\x0f\x00", b"\x0f\x01")
+
+_IDX = opcode_index()
+_MAX_OP_LEN = max(len(op) for op in _IDX)
+
+
+def _imm_len(imm: int, mode: int, has66: bool, rexw: bool) -> int:
+    if imm >= 0:
+        return imm
+    if mode == LONG64:
+        if imm == IMM_OPSIZE64 and rexw:
+            return 8
+        return 2 if (has66 and not rexw) else 4
+    if mode == PROT32:
+        return 2 if has66 else 4
+    return 4 if has66 else 2            # real16 / prot16
+
+
+def _modrm_tail_len(modrm: int, addr16: bool, regonly: bool) -> int:
+    """Bytes following the ModRM byte (SIB + displacement)."""
+    if regonly:
+        return 0
+    mod, rm = modrm >> 6, modrm & 7
+    if mod == 3:
+        return 0
+    if addr16:
+        if mod == 0:
+            return 2 if rm == 6 else 0
+        return 1 if mod == 1 else 2
+    n = 0
+    sib = rm == 4
+    if sib:
+        n += 1
+    if mod == 0:
+        if rm == 5:
+            n += 4
+        elif sib:
+            n += 0  # base!=5 assumed by encoder; decoder peeks SIB below
+    elif mod == 1:
+        n += 1
+    else:
+        n += 4
+    return n
+
+
+# -- encoding ---------------------------------------------------------------
+
+
+def gen_insn(r, mode: int, insn: "Insn | None" = None) -> bytes:
+    """One encoded instruction valid for `mode` (random table pick if
+    `insn` is None), with randomized prefixes/REX/ModRM/imm."""
+    pool = by_mode(mode)
+    if insn is None:
+        insn = pool[r.intn(len(pool))]
+    out = bytearray()
+    if r.one_of(8):
+        out.append(_SEG_PREFIXES[r.intn(len(_SEG_PREFIXES))])
+    has66 = insn.imm in (IMM_OPSIZE, IMM_OPSIZE64) and r.one_of(6)
+    if has66:
+        out.append(0x66)
+    rexw = False
+    if mode == LONG64 and r.one_of(3):
+        rex = 0x40 | r.intn(16)
+        rexw = bool(rex & 8)
+        out.append(rex)
+    op = bytearray(insn.op)
+    if insn.plusr:
+        op[-1] |= r.intn(8)
+    out += op
+    if insn.modrm:
+        regonly = insn.op in _REGONLY_OPS
+        mem_only = insn.op in _MEM_ONLY_OPS
+        while True:
+            modrm = r.intn(256)
+            if insn.digit >= 0:
+                modrm = (modrm & 0xC7) | (insn.digit << 3)
+            if regonly:
+                modrm |= 0xC0
+            mod, rm = modrm >> 6, modrm & 7
+            if mem_only and mod == 3:
+                continue
+            addr16 = mode in (REAL16, PROT16)
+            if not addr16 and not regonly and mod == 0 and rm == 4:
+                # SIB with base=5 adds disp32; avoid that variant so the
+                # tail length is a function of (modrm, sib-presence) only
+                sib = r.intn(256)
+                while sib & 7 == 5:
+                    sib = r.intn(256)
+                out.append(modrm)
+                out.append(sib)
+                break
+            out.append(modrm)
+            tail = _modrm_tail_len(modrm, addr16, regonly)
+            if not addr16 and mod in (1, 2) and rm == 4:
+                out.append(r.intn(256))   # SIB (any base fine: disp follows)
+                tail -= 1
+            out += r.bytes(tail)
+            break
+    out += r.bytes(_imm_len(insn.imm, mode, has66, rexw))
+    return bytes(out)
+
+
+def insn_len(code: bytes, mode: int) -> "int | None":
+    """Length of the instruction at code[0:], or None if unknown —
+    the exact inverse of gen_insn's emission rules."""
+    i, has66, has67 = 0, False, False
+    while i < len(code) and code[i] in _PREFIXES and i < 8:
+        has66 |= code[i] == 0x66
+        has67 |= code[i] == 0x67
+        i += 1
+    rexw = False
+    if mode == LONG64 and i < len(code) and 0x40 <= code[i] <= 0x4F:
+        rexw = bool(code[i] & 8)
+        i += 1
+    entry = None
+    for oplen in range(min(_MAX_OP_LEN, len(code) - i), 0, -1):
+        cands = _IDX.get(bytes(code[i: i + oplen]))
+        if cands:
+            valid = [c for c in cands if c.modes & mode]
+            if not valid:
+                return None
+            if valid[0].modrm and valid[0].digit >= 0:
+                if i + oplen >= len(code):
+                    return None
+                digit = (code[i + oplen] >> 3) & 7
+                match = [c for c in valid if c.digit == digit]
+                if not match:
+                    return None
+                entry = match[0]
+            else:
+                entry = valid[0]
+            i += oplen
+            break
+    if entry is None:  # plusr forms: masked single-byte match
+        b0 = code[i: i + 1]
+        if not b0:
+            return None
+        masked = bytes([b0[0] & 0xF8])
+        for c in _IDX.get(masked, ()):
+            if c.plusr and c.modes & mode:
+                entry = c
+                i += 1
+                break
+        if entry is None:
+            return None
+    if entry.modrm:
+        if i >= len(code):
+            return None
+        modrm = code[i]
+        i += 1
+        regonly = entry.op in _REGONLY_OPS
+        addr16 = (mode in (REAL16, PROT16)) != has67
+        mod, rm = modrm >> 6, modrm & 7
+        if not regonly and mod != 3 and not addr16 and rm == 4:
+            if i >= len(code):
+                return None
+            sib = code[i]
+            i += 1
+            i += 4 if (mod == 0 and sib & 7 == 5) else 0
+            i += 1 if mod == 1 else (4 if mod == 2 else 0)
+        else:
+            i += _modrm_tail_len(modrm, addr16, regonly)
+    i += _imm_len(entry.imm, mode, has66, rexw)
+    return i if i <= len(code) else None
+
+
+def decode_stream(code: bytes, mode: int) -> "list[int] | None":
+    """Instruction start offsets, or None if any byte fails to decode."""
+    offs, i = [], 0
+    while i < len(code):
+        n = insn_len(code[i:], mode)
+        if n is None or n == 0:
+            return None
+        offs.append(i)
+        i += n
+    return offs
+
+
+# -- pseudo-op sequences (ref pseudo.go:10-50) ------------------------------
+
+_MSRS = (0xC0000080, 0xC0000081, 0xC0000082, 0xC0000100, 0xC0000101,
+         0x10, 0x1B, 0x174, 0x175, 0x176, 0x277, 0x8B, 0xFE, 0x179)
+_PORTS = (0xCF8, 0xCFC, 0x60, 0x64, 0x70, 0x71, 0x3F8, 0x80)
+
+
+def _mov_r32_imm(reg: int, val: int, mode: int) -> bytes:
+    """mov r32, imm32 — needs the operand-size override in 16-bit modes
+    so the immediate really is 4 bytes."""
+    pre = b"\x66" if mode in (REAL16, PROT16) else b""
+    return pre + bytes([0xB8 | reg]) + (val & 0xFFFFFFFF).to_bytes(4, "little")
+
+
+def pseudo_wrmsr(r, mode: int) -> bytes:
+    msr = _MSRS[r.intn(len(_MSRS))]
+    lo, hi = r.rand64() & 0xFFFFFFFF, r.rand64() & 0xFFFFFFFF
+    return (_mov_r32_imm(1, msr, mode) + _mov_r32_imm(0, lo, mode)
+            + _mov_r32_imm(2, hi, mode) + b"\x0f\x30")
+
+
+def pseudo_rdmsr(r, mode: int) -> bytes:
+    return _mov_r32_imm(1, _MSRS[r.intn(len(_MSRS))], mode) + b"\x0f\x32"
+
+
+def pseudo_pci_probe(r, mode: int) -> bytes:
+    """out 0xCF8, <cfg addr>; in from 0xCFC — PCI config space pokes."""
+    addr = 0x80000000 | (r.intn(1 << 16) << 8) | (r.intn(64) << 2)
+    return (_mov_r32_imm(2, 0xCF8, mode) + _mov_r32_imm(0, addr, mode)
+            + b"\xef" + _mov_r32_imm(2, 0xCFC, mode) + b"\xed")
+
+
+def pseudo_port_io(r, mode: int) -> bytes:
+    port = _PORTS[r.intn(len(_PORTS))]
+    out = _mov_r32_imm(2, port, mode)
+    out += bytes([(0xEC, 0xED, 0xEE, 0xEF)[r.intn(4)]])
+    return out
+
+
+def pseudo_cpuid(r, mode: int) -> bytes:
+    return (_mov_r32_imm(0, r.intn(32) if r.bin() else 0x80000000 + r.intn(9),
+                         mode)
+            + _mov_r32_imm(1, r.intn(4), mode) + b"\x0f\xa2")
+
+
+PSEUDOS = (pseudo_wrmsr, pseudo_rdmsr, pseudo_pci_probe, pseudo_port_io,
+           pseudo_cpuid)
+
+
+# -- public API --------------------------------------------------------------
+
+
+def generate(r, mode: int, ninsns: "int | None" = None) -> bytes:
+    """An instruction stream for `mode`: table picks with an occasional
+    pseudo-op sequence mixed in (ref ifuzz generate + pseudo tables)."""
+    if ninsns is None:
+        ninsns = 2 + r.intn(12)
+    out = bytearray()
+    for _ in range(ninsns):
+        if r.one_of(10):
+            out += PSEUDOS[r.intn(len(PSEUDOS))](r, mode)
+        else:
+            out += gen_insn(r, mode)
+    return bytes(out)
+
+
+def mutate(r, code: bytes, mode: int) -> bytes:
+    """Instruction-aware mutation: insert/replace/delete whole
+    instructions when the stream decodes, byte-level tweaks otherwise
+    (mirrors the reference's mutate-over-decode design)."""
+    code = bytearray(code)
+    offs = decode_stream(bytes(code), mode)
+    if offs:
+        bounds = offs + [len(code)]
+        k = r.intn(len(offs))
+        lo, hi = bounds[k], bounds[k + 1]
+        which = r.intn(3)
+        if which == 0:    # replace one instruction
+            code[lo:hi] = gen_insn(r, mode)
+        elif which == 1:  # insert before it
+            code[lo:lo] = (PSEUDOS[r.intn(len(PSEUDOS))](r, mode)
+                           if r.one_of(6) else gen_insn(r, mode))
+        else:             # delete it
+            del code[lo:hi]
+    else:
+        if len(code) == 0 or r.bin():
+            code += gen_insn(r, mode)
+        else:
+            code[r.intn(len(code))] = r.intn(256)
+    return bytes(code)
+
+
+# arm64: fixed-width 4-byte words; emit from a tiny pattern set so
+# streams are mostly-decodable (nop/mov/svc/ret/mrs plus random words)
+_ARM64_PATTERNS = (0xD503201F, 0xD2800000, 0xD4000001, 0xD65F03C0,
+                   0xD5300000, 0x8B000000, 0xF9400000)
+
+
+def generate_arm64(r, nwords: "int | None" = None) -> bytes:
+    if nwords is None:
+        nwords = 4 + r.intn(28)
+    out = bytearray()
+    for _ in range(nwords):
+        w = (_ARM64_PATTERNS[r.intn(len(_ARM64_PATTERNS))]
+             | (r.rand64() & 0x001F03E0))
+        if r.one_of(8):
+            w = r.rand64() & 0xFFFFFFFF
+        out += int(w).to_bytes(4, "little")
+    return bytes(out)

@@ -1,0 +1,172 @@
+"""Curated x86 instruction table for the ifuzz equivalent.
+
+The reference generates its ~2k-entry table from Intel XED dumps
+(ifuzz/ifuzz.go:4-7, insns.go); this build hand-curates the encodings
+that matter for kernel/KVM fuzzing — privileged and system instructions,
+MSR/port/descriptor-table access, plus enough ordinary ALU/mov/branch
+traffic to make streams realistic — with full ModRM/SIB/displacement
+and operand-size metadata so encode and decode agree byte-for-byte.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+# mode bits
+REAL16, PROT16, PROT32, LONG64 = 1, 2, 4, 8
+ALL = REAL16 | PROT16 | PROT32 | LONG64
+NOT64 = REAL16 | PROT16 | PROT32
+
+# imm field values
+IMM_OPSIZE = -1   # 2 or 4 bytes by operand size (imm32 in long64)
+IMM_OPSIZE64 = -2  # like IMM_OPSIZE but REX.W takes it to 8 (mov r64, imm64)
+
+
+@dataclass(frozen=True)
+class Insn:
+    name: str
+    op: bytes                # opcode bytes (0x0F escapes included)
+    modrm: bool = False      # has a ModRM byte
+    digit: int = -1          # fixed reg-field /digit, -1 = any register
+    imm: int = 0             # immediate length (IMM_OPSIZE* special)
+    plusr: bool = False      # low 3 opcode bits encode a register
+    modes: int = ALL
+    priv: bool = False       # ring-0 (useful: the target IS a kernel)
+
+
+# fmt: off
+TABLE: list[Insn] = [
+    # -- ordinary data/ALU traffic ------------------------------------------
+    Insn("mov_rm_r",    b"\x89", modrm=True),
+    Insn("mov_r_rm",    b"\x8b", modrm=True),
+    Insn("mov_rm8_r8",  b"\x88", modrm=True),
+    Insn("mov_r_imm",   b"\xb8", plusr=True, imm=IMM_OPSIZE64),
+    Insn("mov_r8_imm",  b"\xb0", plusr=True, imm=1),
+    Insn("mov_rm_imm",  b"\xc7", modrm=True, digit=0, imm=IMM_OPSIZE),
+    Insn("add_rm_r",    b"\x01", modrm=True),
+    Insn("add_r_rm",    b"\x03", modrm=True),
+    Insn("adc_rm_r",    b"\x11", modrm=True),
+    Insn("sub_rm_r",    b"\x29", modrm=True),
+    Insn("cmp_rm_r",    b"\x39", modrm=True),
+    Insn("and_rm_r",    b"\x21", modrm=True),
+    Insn("or_rm_r",     b"\x09", modrm=True),
+    Insn("xor_rm_r",    b"\x31", modrm=True),
+    Insn("test_rm_r",   b"\x85", modrm=True),
+    Insn("xchg_rm_r",   b"\x87", modrm=True),
+    Insn("lea",         b"\x8d", modrm=True),
+    Insn("grp1_add_imm", b"\x81", modrm=True, digit=0, imm=IMM_OPSIZE),
+    Insn("grp1_or_imm",  b"\x81", modrm=True, digit=1, imm=IMM_OPSIZE),
+    Insn("grp1_and_imm", b"\x81", modrm=True, digit=4, imm=IMM_OPSIZE),
+    Insn("grp1_cmp_imm", b"\x81", modrm=True, digit=7, imm=IMM_OPSIZE),
+    Insn("grp1_add_imm8", b"\x83", modrm=True, digit=0, imm=1),
+    Insn("grp1_xor_imm8", b"\x83", modrm=True, digit=6, imm=1),
+    Insn("grp3_test_imm", b"\xf7", modrm=True, digit=0, imm=IMM_OPSIZE),
+    Insn("grp3_not",    b"\xf7", modrm=True, digit=2),
+    Insn("grp3_neg",    b"\xf7", modrm=True, digit=3),
+    Insn("grp3_mul",    b"\xf7", modrm=True, digit=4),
+    Insn("grp3_div",    b"\xf7", modrm=True, digit=6),
+    Insn("inc_rm",      b"\xff", modrm=True, digit=0),
+    Insn("dec_rm",      b"\xff", modrm=True, digit=1),
+    Insn("push_rm",     b"\xff", modrm=True, digit=6),
+    Insn("push_r",      b"\x50", plusr=True),
+    Insn("pop_r",       b"\x58", plusr=True),
+    Insn("push_imm8",   b"\x6a", imm=1),
+    Insn("movzx_r_rm8", b"\x0f\xb6", modrm=True),
+    Insn("movsx_r_rm8", b"\x0f\xbe", modrm=True),
+    Insn("imul_r_rm",   b"\x0f\xaf", modrm=True),
+    Insn("shl_rm_imm",  b"\xc1", modrm=True, digit=4, imm=1),
+    Insn("shr_rm_imm",  b"\xc1", modrm=True, digit=5, imm=1),
+    Insn("sar_rm_imm",  b"\xc1", modrm=True, digit=7, imm=1),
+    Insn("nop",         b"\x90"),
+    Insn("cwde",        b"\x98"),
+    Insn("cdq",         b"\x99"),
+    Insn("sahf",        b"\x9e", modes=NOT64),
+    Insn("lahf",        b"\x9f", modes=NOT64),
+    # -- control flow --------------------------------------------------------
+    Insn("jmp_rel8",    b"\xeb", imm=1),
+    Insn("jz_rel8",     b"\x74", imm=1),
+    Insn("jnz_rel8",    b"\x75", imm=1),
+    Insn("jc_rel8",     b"\x72", imm=1),
+    Insn("loop_rel8",   b"\xe2", imm=1),
+    Insn("call_rel",    b"\xe8", imm=IMM_OPSIZE),
+    Insn("jmp_rel",     b"\xe9", imm=IMM_OPSIZE),
+    Insn("ret",         b"\xc3"),
+    Insn("int3",        b"\xcc"),
+    Insn("int_imm8",    b"\xcd", imm=1),
+    Insn("into",        b"\xce", modes=NOT64),
+    Insn("iret",        b"\xcf"),
+    # -- flags / string / misc user-level system interplay -------------------
+    Insn("cli",         b"\xfa", priv=True),
+    Insn("sti",         b"\xfb", priv=True),
+    Insn("clc",         b"\xf8"),
+    Insn("stc",         b"\xf9"),
+    Insn("cld",         b"\xfc"),
+    Insn("std",         b"\xfd"),
+    Insn("cpuid",       b"\x0f\xa2"),
+    Insn("rdtsc",       b"\x0f\x31"),
+    Insn("rdpmc",       b"\x0f\x33", priv=True),
+    Insn("pushf",       b"\x9c"),
+    Insn("popf",        b"\x9d"),
+    # -- port I/O (PCI config space probing, ref pseudo.go) ------------------
+    Insn("in_al_imm8",  b"\xe4", imm=1, priv=True),
+    Insn("in_eax_imm8", b"\xe5", imm=1, priv=True),
+    Insn("out_imm8_al", b"\xe6", imm=1, priv=True),
+    Insn("out_imm8_eax", b"\xe7", imm=1, priv=True),
+    Insn("in_al_dx",    b"\xec", priv=True),
+    Insn("in_eax_dx",   b"\xed", priv=True),
+    Insn("out_dx_al",   b"\xee", priv=True),
+    Insn("out_dx_eax",  b"\xef", priv=True),
+    # -- privileged / system (the KVM-fuzzing payload) -----------------------
+    Insn("hlt",         b"\xf4", priv=True),
+    Insn("rdmsr",       b"\x0f\x32", priv=True),
+    Insn("wrmsr",       b"\x0f\x30", priv=True),
+    Insn("wbinvd",      b"\x0f\x09", priv=True),
+    Insn("invd",        b"\x0f\x08", priv=True),
+    Insn("clts",        b"\x0f\x06", priv=True),
+    Insn("rsm",         b"\x0f\xaa", priv=True),
+    Insn("ud2",         b"\x0f\x0b"),
+    Insn("mov_r_cr",    b"\x0f\x20", modrm=True, priv=True),
+    Insn("mov_cr_r",    b"\x0f\x22", modrm=True, priv=True),
+    Insn("mov_r_dr",    b"\x0f\x21", modrm=True, priv=True),
+    Insn("mov_dr_r",    b"\x0f\x23", modrm=True, priv=True),
+    Insn("sgdt",        b"\x0f\x01", modrm=True, digit=0, priv=True),
+    Insn("sidt",        b"\x0f\x01", modrm=True, digit=1, priv=True),
+    Insn("lgdt",        b"\x0f\x01", modrm=True, digit=2, priv=True),
+    Insn("lidt",        b"\x0f\x01", modrm=True, digit=3, priv=True),
+    Insn("smsw",        b"\x0f\x01", modrm=True, digit=4, priv=True),
+    Insn("lmsw",        b"\x0f\x01", modrm=True, digit=6, priv=True),
+    Insn("invlpg",      b"\x0f\x01", modrm=True, digit=7, priv=True),
+    Insn("sldt",        b"\x0f\x00", modrm=True, digit=0, priv=True),
+    Insn("str",         b"\x0f\x00", modrm=True, digit=1, priv=True),
+    Insn("lldt",        b"\x0f\x00", modrm=True, digit=2, priv=True),
+    Insn("ltr",         b"\x0f\x00", modrm=True, digit=3, priv=True),
+    Insn("verr",        b"\x0f\x00", modrm=True, digit=4, priv=True),
+    Insn("verw",        b"\x0f\x00", modrm=True, digit=5, priv=True),
+    Insn("lar",         b"\x0f\x02", modrm=True, priv=True),
+    Insn("lsl",         b"\x0f\x03", modrm=True, priv=True),
+    Insn("sysenter",    b"\x0f\x34", modes=PROT32 | LONG64),
+    Insn("sysexit",     b"\x0f\x35", priv=True, modes=PROT32 | LONG64),
+    Insn("syscall",     b"\x0f\x05", modes=LONG64),
+    Insn("sysret",      b"\x0f\x07", priv=True, modes=LONG64),
+    Insn("swapgs",      b"\x0f\x01\xf8", modes=LONG64, priv=True),
+    Insn("rdtscp",      b"\x0f\x01\xf9"),
+    Insn("monitor",     b"\x0f\x01\xc8", priv=True),
+    Insn("mwait",       b"\x0f\x01\xc9", priv=True),
+    Insn("vmcall",      b"\x0f\x01\xc1"),
+    Insn("xgetbv",      b"\x0f\x01\xd0"),
+    Insn("xsetbv",      b"\x0f\x01\xd1", priv=True),
+]
+# fmt: on
+
+
+def by_mode(mode_bit: int) -> list[Insn]:
+    return [i for i in TABLE if i.modes & mode_bit]
+
+
+def opcode_index() -> dict[bytes, list[Insn]]:
+    """opcode bytes -> entries (entries sharing an opcode differ by
+    /digit; 3-byte 0F 01 xx forms are keyed on all 3 bytes)."""
+    idx: dict[bytes, list[Insn]] = {}
+    for i in TABLE:
+        idx.setdefault(i.op, []).append(i)
+    return idx

@@ -43,6 +43,8 @@ def serve(mgr, host: str, port: int) -> ThreadingHTTPServer:
                     self._send(crash(mgr, q.get("id", [""])[0]))
                 elif u.path == "/prio":
                     self._send(prio(mgr, q.get("call", [""])[0]))
+                elif u.path == "/cover":
+                    self._send(cover(mgr, q.get("call", [""])[0]))
                 elif u.path == "/log":
                     self._send("<pre>%s</pre>" %
                                html_mod.escape(log.cached_log()))
@@ -86,7 +88,8 @@ def summary(mgr) -> str:
             f"<p>uptime {up // 3600}h{(up % 3600) // 60}m, "
             f"corpus <a href='/corpus'>{ncorpus}</a>, cover {cover}, "
             f"fuzzers {_esc(fuzzers)}</p>"
-            f"<p><a href='/prio'>priorities</a> | <a href='/log'>log</a></p>"
+            f"<p><a href='/prio'>priorities</a> | "
+            f"<a href='/cover'>coverage</a> | <a href='/log'>log</a></p>"
             f"<h3>Stats</h3><table>{rows}</table>"
             f"<h3>Crashes</h3><table><tr><th>description</th><th>count</th>"
             f"</tr>{crows}</table>")
@@ -107,6 +110,54 @@ def crash(mgr, title: str) -> str:
         count = mgr.crash_types.get(title, 0)
     return (f"{_STYLE}<h2>{_esc(title)}</h2><p>count: {count}; "
             f"logs under workdir/crashes/</p>")
+
+
+_cover_cache: dict = {}
+
+
+def cover(mgr, call: str) -> str:
+    """Coverage viewer (ref html.go corpus/cover pages + cover.go line
+    report): per-call corpus-cover counts (the state the manager's
+    admission path maintains), raw covered PCs for one call, and — when
+    a vmlinux was scanned — the per-file line HTML, cached per covered
+    set (symbolization costs minutes on a real kernel)."""
+    table = mgr.table
+    if call and call in table.call_map:
+        cid = table.call_map[call].id
+        idx = mgr.engine.cover_pcs(cid)
+        pcs = mgr.pcmap.pcs_of(idx)
+        shown = ", ".join(f"0x{int(p):x}" for p in pcs[:512])
+        return (f"{_STYLE}<h2>cover for {_esc(call)}</h2>"
+                f"<p>{len(idx)} PCs ({len(pcs)} mapped)</p>"
+                f"<pre>{shown}</pre>")
+    counts = mgr.engine.cover_counts()
+    rows = "".join(
+        f"<tr><td><a href='/cover?call={_esc(c.name)}'>{_esc(c.name)}</a>"
+        f"</td><td>{int(counts[c.id])}</td></tr>"
+        for c in table.calls if counts[c.id] > 0)
+    body = (f"{_STYLE}<h2>coverage</h2>"
+            f"<p>total covered PCs: {int(counts.sum())}, "
+            f"pcmap {len(mgr.pcmap)} mapped / "
+            f"{mgr.pcmap.overflow_hits} overflow hits</p>"
+            f"<table><tr><th>call</th><th>PCs</th></tr>{rows}</table>")
+    scan = getattr(mgr, "cover_scan", None)
+    if scan is not None and scan.ready.is_set() and scan.pcs:
+        from syzkaller_tpu.manager.kcov import (
+            generate_cover_html, restore_pc, vm_offset)
+        idx = mgr.engine.covered_indices()
+        pcs32 = mgr.pcmap.pcs_of(idx)
+        if len(pcs32):
+            key = (id(mgr), len(pcs32))
+            report = _cover_cache.get(key)
+            if report is None:
+                base = vm_offset(mgr.cfg.vmlinux)
+                covered = [restore_pc(int(p), base) for p in pcs32]
+                report = generate_cover_html(mgr.cfg.vmlinux, covered,
+                                             scan.pcs)
+                _cover_cache.clear()       # one report per manager
+                _cover_cache[key] = report
+            body += report
+    return body
 
 
 def prio(mgr, call: str) -> str:

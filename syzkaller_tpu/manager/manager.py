@@ -79,6 +79,13 @@ class Manager:
         self.engine.set_enabled(
             [self.table.call_map[n].id for n in self.enabled_names])
         self.pcmap = PcMap(cfg.npcs)
+        # async vmlinux PC-universe scan (ref cover.go:57-69 initAllCover):
+        # pre-seeds the PcMap for restart-stable bitmap indices and feeds
+        # the /cover line report
+        self.cover_scan = None
+        if cfg.vmlinux and os.path.exists(cfg.vmlinux):
+            from syzkaller_tpu.manager.kcov import CoverScanner
+            self.cover_scan = CoverScanner(cfg.vmlinux, pcmap=self.pcmap)
 
         def verify(data: bytes) -> bool:
             try:

@@ -136,6 +136,16 @@ def _mutate_one(a: M.Arg, c: M.Call, gen: Gen) -> list[M.Call]:
                 a.val ^= 1 << r.intn(64)
         return []
     if isinstance(a, M.DataArg):
+        if getattr(t, "kind", None) == T.BufferKind.TEXT:
+            # instruction-aware mutation (ifuzz, ref ifuzz/mutate path)
+            from syzkaller_tpu import ifuzz as IF
+            from syzkaller_tpu.prog.rand import text_mode
+            mode = text_mode(t)
+            if mode is None:
+                a.data = IF.generate_arm64(r)
+            else:
+                a.data = IF.mutate(r, a.data, mode)
+            return []
         data = bytearray(a.data)
         mutate_data(r, data, t)
         a.data = bytes(data)

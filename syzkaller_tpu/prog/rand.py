@@ -25,6 +25,19 @@ from syzkaller_tpu.sys import types as T
 from syzkaller_tpu.sys.table import SyscallTable
 
 
+def text_mode(t) -> "int | None":
+    """TextKind → ifuzz x86 mode bit (None = arm64/unknown)."""
+    from syzkaller_tpu import ifuzz as IF
+    from syzkaller_tpu.sys import types as TT
+
+    return {
+        TT.TextKind.X86_REAL: IF.REAL16,
+        TT.TextKind.X86_16: IF.PROT16,
+        TT.TextKind.X86_32: IF.PROT32,
+        TT.TextKind.X86_64: IF.LONG64,
+    }.get(getattr(t, "text_kind", None))
+
+
 class Rand:
     """Uniform-uint64 stream with fuzzing-flavored helpers.
 
@@ -363,8 +376,13 @@ class Gen:
         if t.kind == T.BufferKind.FILENAME:
             return M.DataArg(t, self.filename()), []
         if t.kind == T.BufferKind.TEXT:
-            # Raw machine-code bytes; the ifuzz equivalent upgrades this.
-            return M.DataArg(t, r.bytes(16 + r.intn(48))), []
+            # mode-aware instruction streams (ifuzz equivalent,
+            # ref ifuzz/ifuzz.go:16-22 + prog/rand.go TEXT path)
+            from syzkaller_tpu import ifuzz as IF
+            mode = text_mode(t)
+            if mode is None:
+                return M.DataArg(t, IF.generate_arm64(r)), []
+            return M.DataArg(t, IF.generate(r, mode)), []
         raise TypeError(f"buffer kind {t.kind}")
 
     def _special_struct(self, t: T.StructType) -> "tuple[M.Arg, list[M.Call]] | None":

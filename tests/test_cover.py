@@ -247,3 +247,60 @@ def test_compact_corpus(rng):
     idxf, validf = make_batch([fresh, fresh])
     _, _, bm = eng.triage_diff(np.array([2, 3], np.int32), idxf, validf)
     assert eng.merge_corpus(np.array([2, 3], np.int32), bm) is not None
+
+
+def test_minimize_scan_is_valid_cover(rng):
+    """The large-corpus scan minimizer must produce a valid set cover:
+    union of kept rows == union of all active rows."""
+    from syzkaller_tpu.cover.engine import minimize_cover_scan
+    import jax.numpy as jnp
+
+    W = nwords_for(NPCS)
+    C = 64
+    mat = rng.integers(0, 1 << 32, size=(C, W), dtype=np.uint64).astype(np.uint32)
+    # make some rows subsets of others so minimization has work to do
+    for i in range(0, C, 4):
+        mat[i] = mat[(i + 1) % C] & mat[(i + 2) % C]
+    active = np.ones((C,), bool)
+    active[C - 8:] = False
+    keep = np.asarray(minimize_cover_scan(jnp.asarray(mat), jnp.asarray(active)))
+    assert not keep[C - 8:].any()
+    union_all = np.zeros((W,), np.uint32)
+    union_kept = np.zeros((W,), np.uint32)
+    for i in range(C - 8):
+        union_all |= mat[i]
+        if keep[i]:
+            union_kept |= mat[i]
+    assert (union_all == union_kept).all()
+    assert keep.sum() < (C - 8)  # subsets were dropped
+
+
+def test_minimize_corpus_large_uses_scan(rng):
+    eng = CoverageEngine(npcs=NPCS, ncalls=4, corpus_cap=8192, batch=8)
+    eng.MINIMIZE_SCAN_THRESHOLD = 16  # force the scan path
+    covers = [rand_cover(rng, 20) for _ in range(32)]
+    covers += [covers[i][:10] for i in range(16)]  # strict subsets
+    idx, valid = make_batch(covers, K=32)
+    bm = eng.pack_batch(idx, valid)
+    eng.merge_corpus(np.zeros(len(covers), np.int32), bm)
+    keep = eng.minimize_corpus()
+    assert keep[: len(covers)].sum() <= 32
+    # survivors still cover everything
+    union_all = set(np.concatenate(covers).tolist())
+    covered = set()
+    for i in np.nonzero(keep)[0]:
+        covered |= set(bitmap_to_pcs(np.asarray(eng.corpus_mat[i])).tolist())
+    assert covered == union_all
+
+
+def test_sample_corpus_rows(rng):
+    eng = CoverageEngine(npcs=NPCS, ncalls=4, corpus_cap=64, batch=8)
+    big = rand_cover(rng, 200)   # row 0: lots of signal
+    small = rand_cover(rng, 2)   # row 1: little signal
+    idx, valid = make_batch([big, small], K=256)
+    eng.merge_corpus(np.zeros(2, np.int32), eng.pack_batch(idx, valid))
+    rows = eng.sample_corpus_rows(512)
+    assert rows.shape == (512,)
+    assert set(rows.tolist()) <= {0, 1}
+    # popcount-weighted: the signal-rich row dominates
+    assert (rows == 0).sum() > (rows == 1).sum()

@@ -141,9 +141,11 @@ def test_fuzzer_device_integration(tmp_path):
                  http="", corpus_cap=1 << 12, fuzzer_device=True)
     mgr = Manager(cfg)
     assert "-device" in mgr.fuzzer_cmdline(0, "127.0.0.1:1")
-    t = threading.Thread(target=mgr.run, kwargs={"duration": 30.0})
+    # generous duration: the fuzzer subprocess pays jax import + engine
+    # compile (~15s on CPU) before its first flush
+    t = threading.Thread(target=mgr.run, kwargs={"duration": 45.0})
     t.start()
-    t.join(timeout=90.0)
+    t.join(timeout=150.0)
     assert not t.is_alive()
     with mgr._mu:
         execs = mgr.stats.get("exec total", 0)

@@ -1,0 +1,129 @@
+"""ifuzz property tests: generated streams decode exactly at emitted
+boundaries in every mode (the invariant the reference pins via its
+XED-derived tables), pseudo-ops decode, mutation preserves
+decodability, and a spot-check against objdump as reference decoder."""
+
+import shutil
+import subprocess
+
+import numpy as np
+import pytest
+
+from syzkaller_tpu import ifuzz as IF
+from syzkaller_tpu import prog as P
+
+
+@pytest.fixture
+def r(rng):
+    return P.Rand(rng)
+
+
+@pytest.mark.parametrize("mode", IF.MODES)
+def test_gen_insn_roundtrip(r, mode):
+    for _ in range(2000):
+        code = IF.gen_insn(r, mode)
+        n = IF.insn_len(code, mode)
+        assert n == len(code), f"mode {mode}: {code.hex()} -> {n}"
+
+
+@pytest.mark.parametrize("mode", IF.MODES)
+def test_generate_stream_decodes(r, mode):
+    for _ in range(200):
+        code = IF.generate(r, mode)
+        offs = IF.decode_stream(code, mode)
+        assert offs is not None and offs[0] == 0
+
+
+@pytest.mark.parametrize("mode", IF.MODES)
+def test_pseudo_sequences_decode(r, mode):
+    for fn in IF.PSEUDOS:
+        for _ in range(50):
+            code = fn(r, mode)
+            assert IF.decode_stream(code, mode) is not None, \
+                f"{fn.__name__}: {code.hex()}"
+
+
+@pytest.mark.parametrize("mode", IF.MODES)
+def test_mutate_keeps_decodability(r, mode):
+    code = IF.generate(r, mode, ninsns=6)
+    for _ in range(300):
+        code = IF.mutate(r, code, mode)
+        # mutation of a decodable stream stays decodable (insert/
+        # replace/delete whole instructions)
+        assert IF.decode_stream(code, mode) is not None
+
+
+def test_mutate_recovers_garbage(r):
+    # an undecodable buffer (e.g. from corpus splice) must not crash and
+    # eventually grows decodable instructions
+    code = b"\x0e\x17\x62"
+    for _ in range(50):
+        code = IF.mutate(r, code, IF.LONG64)
+    assert len(code) > 0
+
+
+def test_modes_filter_table():
+    longonly = {i.name for i in IF.TABLE if i.modes == IF.LONG64}
+    assert "syscall" in longonly and "swapgs" in longonly
+    for i in IF.by_mode(IF.REAL16):
+        assert i.modes & IF.REAL16
+
+
+def test_arm64_words(r):
+    code = IF.generate_arm64(r)
+    assert len(code) % 4 == 0 and len(code) > 0
+
+
+@pytest.mark.skipif(shutil.which("objdump") is None, reason="no objdump")
+@pytest.mark.parametrize("mode,march", [(IF.PROT32, "i386"),
+                                        (IF.LONG64, "i386:x86-64")])
+def test_insn_len_vs_objdump(r, mode, march, tmp_path):
+    """Cross-check our length decoder against binutils on a generated
+    stream (reference-implementation testing, SURVEY §4.4)."""
+    code = b"".join(IF.gen_insn(r, mode) for _ in range(200))
+    raw = tmp_path / "code.bin"
+    raw.write_bytes(code)
+    out = subprocess.run(
+        ["objdump", "-D", "-b", "binary", "-m", march, str(raw)],
+        capture_output=True, text=True).stdout
+    # objdump prints "   <off>:\t<insn>"; collect its boundaries
+    obj_offs = []
+    for line in out.splitlines():
+        parts = line.split(":")
+        if len(parts) >= 2 and parts[0].strip().isalnum():
+            try:
+                obj_offs.append(int(parts[0].strip(), 16))
+            except ValueError:
+                pass
+    ours = IF.decode_stream(code, mode)
+    assert ours is not None
+    # objdump may merge prefixes oddly on (bad) combinations; require
+    # overwhelming agreement rather than identity
+    agree = len(set(ours) & set(obj_offs))
+    assert agree / len(ours) > 0.9, f"only {agree}/{len(ours)} boundaries agree"
+
+
+def test_text_args_are_instruction_streams(r):
+    """The generator produces decodable TEXT buffers end-to-end."""
+    from syzkaller_tpu.sys.table import load_table
+
+    table = load_table(files=["probe.txt"])
+    text_calls = [c for c in table.calls if "text" in c.name]
+    assert text_calls
+    found = 0
+    for c in text_calls:
+        for _ in range(5):
+            state = P.State(table)
+            gen = P.Gen(r, state, table, None)
+            calls = gen.generate_particular_call(c)
+            for call in calls:
+                for arg in call.args:
+                    res = getattr(arg, "res", None)
+                    if res is not None and hasattr(res, "data"):
+                        found += 1
+                        assert len(res.data) > 0
+                        mode = P.rand.text_mode(res.typ) \
+                            if hasattr(res.typ, "text_kind") else None
+                        if mode is not None:
+                            assert IF.decode_stream(res.data, mode) is not None
+    assert found > 0
