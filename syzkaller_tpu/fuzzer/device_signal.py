@@ -106,10 +106,8 @@ class DeviceSignal:
         _has, new, _bm = self.engine.triage_diff(call_ids, idx, valid)
         new = np.asarray(new)
         pc_idx = self.pcmap.indices_of(cover)
-        keep = np.zeros((len(cover),), bool)
-        for k, pidx in enumerate(pc_idx):
-            r = k // self.K                    # the chunk row holding it
-            keep[k] = (new[r][pidx >> 5] >> (pidx & 31)) & 1
+        rows = np.arange(len(cover)) // self.K    # the chunk row per PC
+        keep = ((new[rows, pc_idx >> 5] >> (pc_idx & 31)) & 1).astype(bool)
         return cover[keep]
 
     def add_flakes(self, call_id: int, pcs: np.ndarray) -> None:

@@ -1,0 +1,74 @@
+"""Hub HTTP status page (ref syz-hub/http.go, 259 LoC): global corpus
+size plus a per-manager table of corpus/added/new counters, and the
+in-memory log cache."""
+
+from __future__ import annotations
+
+import html as html_mod
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+from syzkaller_tpu.utils import log
+
+_STYLE = """<style>
+body { font-family: monospace; margin: 1em; }
+table { border-collapse: collapse; }
+td, th { border: 1px solid #999; padding: 2px 8px; text-align: left; }
+</style>"""
+
+
+def summary(hub, start_time: float) -> str:
+    st = hub.state
+    up = int(time.time() - start_time)
+    rows = []
+    total_added = total_new = 0
+    for name in sorted(st.managers):
+        m = st.managers[name]
+        new = max(0, len(st.seq) - m.cursor)
+        total_added += m.added
+        total_new += new
+        rows.append(f"<tr><td>{html_mod.escape(name)}</td>"
+                    f"<td>{m.cursor}</td><td>{m.added}</td>"
+                    f"<td>{new}</td></tr>")
+    table = "".join(rows)
+    return (f"{_STYLE}<h2>syz-hub</h2>"
+            f"<p>uptime {up // 3600}h{(up % 3600) // 60}m, "
+            f"corpus {len(st.seq)}, managers {len(st.managers)}, "
+            f"added {total_added}, pending {total_new}</p>"
+            f"<table><tr><th>manager</th><th>cursor</th><th>added</th>"
+            f"<th>pending</th></tr>{table}</table>"
+            f"<p><a href='/log'>log</a></p>")
+
+
+def serve(hub, host: str, port: int) -> ThreadingHTTPServer:
+    start_time = time.time()
+
+    class Handler(BaseHTTPRequestHandler):
+        def log_message(self, *args):
+            pass
+
+        def _send(self, body: str, code: int = 200):
+            data = body.encode()
+            self.send_response(code)
+            self.send_header("Content-Type", "text/html; charset=utf-8")
+            self.send_header("Content-Length", str(len(data)))
+            self.end_headers()
+            self.wfile.write(data)
+
+        def do_GET(self):
+            try:
+                if self.path.split("?")[0] == "/":
+                    self._send(summary(hub, start_time))
+                elif self.path.startswith("/log"):
+                    self._send("<pre>%s</pre>" %
+                               html_mod.escape(log.cached_log()))
+                else:
+                    self._send("not found", 404)
+            except Exception as e:  # the UI must not kill the hub
+                self._send(f"error: {html_mod.escape(str(e))}", 500)
+
+    srv = ThreadingHTTPServer((host, port), Handler)
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    log.logf(0, "hub http UI on http://%s:%d", *srv.server_address)
+    return srv

@@ -70,14 +70,21 @@ class Hub:
 def main(argv=None):
     ap = argparse.ArgumentParser()
     ap.add_argument("-addr", default="127.0.0.1:7788")
+    ap.add_argument("-http", default="",
+                    help="status page address, e.g. 127.0.0.1:7789")
     ap.add_argument("-key", default="")
     ap.add_argument("-workdir", default="./hub-workdir")
     ap.add_argument("-v", type=int, default=0)
     args = ap.parse_args(argv)
     log.set_verbosity(args.v)
+    log.enable_log_caching()
     hub = Hub(args.workdir, args.key, args.addr)
     log.logf(0, "hub listening on %s:%d", *hub.addr)
     hub.server.serve_background()
+    if args.http:
+        from syzkaller_tpu.hub import http as hub_http
+        host, _, port = args.http.rpartition(":")
+        hub_http.serve(hub, host or "127.0.0.1", int(port or 0))
     while True:
         time.sleep(60)
 

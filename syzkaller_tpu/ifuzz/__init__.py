@@ -308,9 +308,35 @@ def generate_arm64(r, nwords: "int | None" = None) -> bytes:
         nwords = 4 + r.intn(28)
     out = bytearray()
     for _ in range(nwords):
-        w = (_ARM64_PATTERNS[r.intn(len(_ARM64_PATTERNS))]
-             | (r.rand64() & 0x001F03E0))
-        if r.one_of(8):
-            w = r.rand64() & 0xFFFFFFFF
-        out += int(w).to_bytes(4, "little")
+        out += _arm64_word(r)
     return bytes(out)
+
+
+def _arm64_word(r) -> bytes:
+    w = (_ARM64_PATTERNS[r.intn(len(_ARM64_PATTERNS))]
+         | (r.rand64() & 0x001F03E0))
+    if r.one_of(8):
+        w = r.rand64() & 0xFFFFFFFF
+    return int(w).to_bytes(4, "little")
+
+
+def mutate_arm64(r, code: bytes) -> bytes:
+    """Incremental word-aligned mutation: replace/insert/delete one
+    instruction word or tweak its register fields — corpus text that
+    earned coverage is refined, not discarded."""
+    code = bytearray(code[: len(code) & ~3])
+    if len(code) < 4:
+        return bytes(code) + _arm64_word(r)
+    k = r.intn(len(code) // 4) * 4
+    which = r.intn(4)
+    if which == 0:    # replace one word
+        code[k: k + 4] = _arm64_word(r)
+    elif which == 1:  # insert a word
+        code[k:k] = _arm64_word(r)
+    elif which == 2 and len(code) > 4:  # delete a word
+        del code[k: k + 4]
+    else:             # tweak register/imm fields, keep the opcode class
+        w = int.from_bytes(code[k: k + 4], "little")
+        w ^= int(r.rand64()) & 0x001FFFE0
+        code[k: k + 4] = w.to_bytes(4, "little")
+    return bytes(code)

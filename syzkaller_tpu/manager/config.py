@@ -55,6 +55,15 @@ class Config:
     cpu: int = 1
     image_9p: bool = False
     boot_timeout: float = 600.0
+    # VM-type specific (adb)
+    devices: str = ""                  # comma-separated device serials
+    console: str = ""                  # USB serial console (/dev/ttyUSB*)
+    adb: str = ""                      # adb binary override
+    # VM-type specific (gce)
+    gce_image: str = ""
+    gce_zone: str = ""
+    machine_type: str = ""
+    gcloud: str = ""
     # repro
     reproduce: bool = True
     # federation (syz-hub)
@@ -88,6 +97,14 @@ class Config:
             raise ConfigError(f"unknown sandbox {self.sandbox!r}")
         if self.type == "qemu" and not (self.kernel or self.image):
             raise ConfigError("qemu requires kernel or image")
+        if self.type == "adb":
+            devs = [d for d in self.devices.split(",") if d.strip()]
+            if not devs:
+                raise ConfigError("adb requires devices")
+            if self.count > len(devs):
+                raise ConfigError(f"count {self.count} > {len(devs)} devices")
+        if self.type == "gce" and not self.gce_image:
+            raise ConfigError("gce requires gce_image")
 
     def enabled_calls(self, table: SyscallTable) -> list[str]:
         """Apply enable/disable globs (ref config.go:183-229)."""

@@ -113,6 +113,7 @@ def crash(mgr, title: str) -> str:
 
 
 _cover_cache: dict = {}
+_cover_cache_mu = threading.Lock()
 
 
 def cover(mgr, call: str) -> str:
@@ -148,14 +149,17 @@ def cover(mgr, call: str) -> str:
         pcs32 = mgr.pcmap.pcs_of(idx)
         if len(pcs32):
             key = (id(mgr), len(pcs32))
-            report = _cover_cache.get(key)
-            if report is None:
-                base = vm_offset(mgr.cfg.vmlinux)
-                covered = [restore_pc(int(p), base) for p in pcs32]
-                report = generate_cover_html(mgr.cfg.vmlinux, covered,
-                                             scan.pcs)
-                _cover_cache.clear()       # one report per manager
-                _cover_cache[key] = report
+            # serialize regeneration: concurrent /cover hits must not
+            # each run the minutes-long symbolization pass
+            with _cover_cache_mu:
+                report = _cover_cache.get(key)
+                if report is None:
+                    base = vm_offset(mgr.cfg.vmlinux)
+                    covered = [restore_pc(int(p), base) for p in pcs32]
+                    report = generate_cover_html(mgr.cfg.vmlinux, covered,
+                                                 scan.pcs)
+                    _cover_cache.clear()       # one report per manager
+                    _cover_cache[key] = report
             body += report
     return body
 

@@ -112,6 +112,8 @@ class Manager:
         self._instances: dict[int, vm.Instance] = {}
         self._hub_client: "rpc.RpcClient | None" = None
         self._hub_synced: set[bytes] = set()
+        self._repro_active: set[str] = set()
+        self._repro_block = 0          # unique index block per repro job
 
         self.server = rpc.RpcServer(*self._split_addr(cfg.rpc))
         self.server.register("Manager.Connect", self.rpc_connect)
@@ -343,6 +345,75 @@ class Manager:
         log.logf(0, "vm crash: %s", title)
         return d
 
+    # -- auto-repro (ref manager.go:269-280, 468-502) ----------------------
+
+    REPRO_VMS = 4          # instances peeled off per repro job (ref :232)
+
+    def maybe_schedule_repro(self, outcome, crash_dir: str) -> None:
+        """One background repro job per crash type: extract suspects from
+        the console log, drive a small VM pool in parallel, and persist
+        repro.prog / repro.cprog next to the crash artifacts."""
+        if not self.cfg.reproduce or outcome.report is None:
+            return
+        title = outcome.title
+        with self._mu:
+            if title in self._repro_active or \
+                    os.path.exists(os.path.join(crash_dir, "repro.prog")):
+                return
+            self._repro_active.add(title)
+        threading.Thread(target=self._repro_job,
+                         args=(outcome, crash_dir, title),
+                         daemon=True).start()
+
+    def _repro_indices(self) -> "list[int] | None":
+        """Instance indices for one repro job.  Backends that can mint
+        instances (qemu/gce/local) get a unique reserved block above the
+        fleet, so concurrent jobs never share workdirs/ports/prog files;
+        fixed-device backends (adb) can only use spare configured
+        devices beyond the fleet — none spare means no auto-repro."""
+        n = min(self.REPRO_VMS, max(1, self.cfg.count))
+        if self.cfg.type == "adb":
+            ndev = len([d for d in self.cfg.devices.split(",") if d.strip()])
+            spare = list(range(self.cfg.count, min(ndev,
+                                                   self.cfg.count + n)))
+            return spare or None
+        with self._mu:
+            block = self._repro_block
+            self._repro_block += 1
+        base = self.cfg.count + 100 + block * self.REPRO_VMS
+        return [base + i for i in range(n)]
+
+    def _repro_job(self, outcome, crash_dir: str, title: str) -> None:
+        from syzkaller_tpu import repro as repro_mod
+
+        indices = self._repro_indices()
+        if indices is None:
+            log.logf(0, "repro for %r skipped: no spare devices", title)
+            with self._mu:
+                self._repro_active.discard(title)
+            return
+        oracle = repro_mod.VmOracle(self.cfg, self.table, indices,
+                                    suppressions=self.cfg.compiled_suppressions())
+        try:
+            result = repro_mod.run(outcome.output, self.table, oracle)
+            if result is not None and result.prog is not None:
+                with open(os.path.join(crash_dir, "repro.prog"), "wb") as f:
+                    f.write(P.serialize(result.prog))
+                if result.c_repro:
+                    with open(os.path.join(crash_dir, "repro.cprog"), "w") as f:
+                        f.write(result.c_repro)
+                log.logf(0, "repro for %r: %d calls%s", title,
+                         len(result.prog.calls),
+                         ", C repro" if result.c_repro else "")
+            else:
+                log.logf(0, "repro for %r failed", title)
+        except Exception as e:
+            log.logf(0, "repro job for %r error: %s", title, e)
+        finally:
+            oracle.close()
+            with self._mu:
+                self._repro_active.discard(title)
+
     # -- VM loop (ref manager.go:230-341) ----------------------------------
 
     def fuzzer_cmdline(self, index: int, manager_addr: str) -> str:
@@ -385,7 +456,8 @@ class Manager:
                 handle.stop()
                 # shutdown kills the fuzzer: its EOF is not a crash
                 if outcome.crashed and not self._stop:
-                    self.save_crash(outcome)
+                    crash_dir = self.save_crash(outcome)
+                    self.maybe_schedule_repro(outcome, crash_dir)
             except Exception as e:
                 log.logf(0, "vm-%d error: %s", index, e)
                 time.sleep(5.0)

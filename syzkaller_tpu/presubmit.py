@@ -1,0 +1,81 @@
+"""One-command CI gate (ref Makefile:61-69 `make presubmit` =
+generate + build + test): compile the description table, build the
+native executor, run the full pytest suite on the 8-virtual-device CPU
+mesh, and smoke the device engine.
+
+    python -m syzkaller_tpu.presubmit [--quick]
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import subprocess
+import sys
+import time
+
+
+def step(name: str, fn) -> float:
+    t0 = time.time()
+    print(f"[presubmit] {name}...", flush=True)
+    fn()
+    dt = time.time() - t0
+    print(f"[presubmit] {name} ok ({dt:.1f}s)", flush=True)
+    return dt
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--quick", action="store_true",
+                    help="skip the slow integration tests")
+    args = ap.parse_args(argv)
+
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    flags = env.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        env["XLA_FLAGS"] = (
+            flags + " --xla_force_host_platform_device_count=8").strip()
+
+    def gen_tables():
+        from syzkaller_tpu.sys.table import load_table
+        table = load_table()
+        assert table.count >= 250, f"only {table.count} syscalls described"
+        print(f"[presubmit]   {table.count} syscalls, "
+              f"{len(table.resources)} resources")
+
+    def build_executor():
+        from syzkaller_tpu.native.build import build_executor as be
+        path = be()
+        assert os.path.exists(path)
+
+    def pytest_run():
+        cmd = [sys.executable, "-m", "pytest", "tests/", "-x", "-q"]
+        if args.quick:
+            cmd += ["-k", "not integration"]
+        r = subprocess.run(cmd, cwd=root, env=env)
+        if r.returncode != 0:
+            raise SystemExit(f"pytest failed ({r.returncode})")
+
+    def engine_smoke():
+        r = subprocess.run(
+            [sys.executable, "-c",
+             "import __graft_entry__ as g, jax; "
+             "fn, a = g.entry(); jax.block_until_ready(jax.jit(fn)(*a)); "
+             "g.dryrun_multichip(8); print('engine ok')"],
+            cwd=root, env=env)
+        if r.returncode != 0:
+            raise SystemExit("engine smoke failed")
+
+    total = 0.0
+    total += step("description tables", gen_tables)
+    total += step("native executor build", build_executor)
+    total += step("engine + multichip smoke", engine_smoke)
+    total += step("pytest", pytest_run)
+    print(f"[presubmit] PASS in {total:.0f}s")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

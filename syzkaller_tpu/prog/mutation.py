@@ -142,7 +142,7 @@ def _mutate_one(a: M.Arg, c: M.Call, gen: Gen) -> list[M.Call]:
             from syzkaller_tpu.prog.rand import text_mode
             mode = text_mode(t)
             if mode is None:
-                a.data = IF.generate_arm64(r)
+                a.data = IF.mutate_arm64(r, a.data)
             else:
                 a.data = IF.mutate(r, a.data, mode)
             return []

@@ -1,3 +1,4 @@
 """Automatic crash reproduction."""
 
-from syzkaller_tpu.repro.repro import Result, run  # noqa: F401
+from syzkaller_tpu.repro.repro import (  # noqa: F401
+    Oracle, Result, VmOracle, run, vm_test_fn)

@@ -17,6 +17,7 @@ from __future__ import annotations
 import os
 import shlex
 import sys
+import threading
 import time
 from dataclasses import dataclass, field
 from typing import Callable
@@ -33,6 +34,73 @@ from syzkaller_tpu.vm.monitor import monitor_execution
 TestFn = Callable[[bytes, csource.Options, float], bool]
 
 
+class Oracle:
+    """Crash-testing backend.  `test` answers one question; `first_crasher`
+    answers many, in parallel when the backend has multiple machines
+    (ref repro.go:61-116 peels 4 VMs off the fleet and boots/tests them
+    concurrently).  A bare TestFn is wrapped with the serial default."""
+
+    def __init__(self, test: TestFn, workers: int = 1):
+        self.test = test
+        self.workers = max(1, workers)
+
+    def first_crasher(self, items: "list[tuple[bytes, csource.Options]]",
+                      duration: float) -> "int | None":
+        """Index of the earliest item that reproduces, or None.  Earlier
+        items are preferred (suspects are ordered most-likely-first)."""
+        if self.workers == 1 or len(items) <= 1:
+            for i, (data, opts) in enumerate(items):
+                if self.test(data, opts, duration):
+                    return i
+            return None
+        import queue as queue_mod
+
+        jobs: "queue_mod.Queue[int]" = queue_mod.Queue()
+        for i in range(len(items)):
+            jobs.put(i)
+        crashed: set[int] = set()
+        mu = threading.Lock()
+
+        def worker(wid: int):
+            while True:
+                try:
+                    i = jobs.get_nowait()
+                except queue_mod.Empty:
+                    return
+                with mu:
+                    # a confirmed earlier crasher makes later items moot
+                    if crashed and i > min(crashed):
+                        continue
+                try:
+                    hit = self._test_on(wid, items[i][0], items[i][1],
+                                        duration)
+                except Exception as e:
+                    # a broken machine must not silently kill the worker
+                    # (and with it every suspect still queued)
+                    log.logf(0, "repro worker %d: test failed: %s", wid, e)
+                    continue
+                if hit:
+                    with mu:
+                        crashed.add(i)
+
+        threads = [threading.Thread(target=worker, args=(w,), daemon=True)
+                   for w in range(min(self.workers, len(items)))]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        return min(crashed) if crashed else None
+
+    def _test_on(self, wid: int, data: bytes, opts, duration: float) -> bool:
+        """Run one test on worker wid's machine (serial default ignores
+        wid; the VM oracle pins each worker to its own instance)."""
+        return self.test(data, opts, duration)
+
+
+def _as_oracle(fn) -> Oracle:
+    return fn if isinstance(fn, Oracle) else Oracle(fn)
+
+
 @dataclass
 class Result:
     prog: "M.Prog | None" = None
@@ -42,20 +110,41 @@ class Result:
     attempts: int = 0
 
 
-def vm_test_fn(cfg, table: SyscallTable, instance_indices: list[int],
-               suppressions=None) -> TestFn:
-    """The production oracle: run the program via execprog inside a pool
-    VM and watch the console for an oops (ref repro.go:275-304)."""
-    pool: list[vm.Instance] = []
+class VmOracle(Oracle):
+    """The production oracle: run programs via execprog inside pool VMs
+    and watch their consoles for an oops (ref repro.go:275-304).  Each
+    worker owns one instance (lazily booted), so `first_crasher` drives
+    the whole peeled-off pool concurrently (ref repro.go:61-116)."""
 
-    def ensure(i: int) -> vm.Instance:
-        while len(pool) <= i:
-            pool.append(vm.create(cfg.type, cfg, instance_indices[len(pool)]))
-        return pool[i]
+    def __init__(self, cfg, table: SyscallTable, instance_indices: list[int],
+                 suppressions=None):
+        super().__init__(self._test0, workers=max(1, len(instance_indices)))
+        self.cfg = cfg
+        self.indices = instance_indices
+        self.suppressions = suppressions
+        self._pool: dict[int, vm.Instance] = {}
+        self._pool_mu = threading.Lock()
 
-    def test(data: bytes, opts: csource.Options, duration: float) -> bool:
-        inst = ensure(0)
-        prog_path = os.path.join(cfg.workdir, "repro.prog")
+    def _instance(self, wid: int) -> vm.Instance:
+        with self._pool_mu:
+            inst = self._pool.get(wid)
+        if inst is None:
+            inst = vm.create(self.cfg.type, self.cfg, self.indices[wid])
+            with self._pool_mu:
+                self._pool[wid] = inst
+        return inst
+
+    def _test0(self, data: bytes, opts: csource.Options,
+               duration: float) -> bool:
+        return self._test_on(0, data, opts, duration)
+
+    def _test_on(self, wid: int, data: bytes, opts: csource.Options,
+                 duration: float) -> bool:
+        inst = self._instance(wid)
+        # instance-index filename: concurrent repro jobs (each with its
+        # own index block) never overwrite each other's prog files
+        prog_path = os.path.join(self.cfg.workdir,
+                                 f"repro-{self.indices[wid]}.prog")
         with open(prog_path, "wb") as f:
             f.write(data)
         guest_path = inst.copy(prog_path)
@@ -68,12 +157,26 @@ def vm_test_fn(cfg, table: SyscallTable, instance_indices: list[int],
         if opts.collide:
             cmd.append("-collide")
         handle = inst.run(" ".join(shlex.quote(c) for c in cmd), duration)
-        outcome = monitor_execution(handle, duration, ignores=suppressions,
+        outcome = monitor_execution(handle, duration,
+                                    ignores=self.suppressions,
                                     need_executing=False)
         handle.stop()
         return outcome.crashed and outcome.report is not None
 
-    return test
+    def close(self) -> None:
+        with self._pool_mu:
+            insts, self._pool = list(self._pool.values()), {}
+        for inst in insts:
+            try:
+                inst.close()
+            except Exception as e:
+                log.logf(1, "repro: instance close failed: %s", e)
+
+
+def vm_test_fn(cfg, table: SyscallTable, instance_indices: list[int],
+               suppressions=None) -> VmOracle:
+    """Compatibility constructor for the production oracle."""
+    return VmOracle(cfg, table, instance_indices, suppressions)
 
 
 def extract_suspects(crash_log: bytes, table: SyscallTable) -> list[M.Prog]:
@@ -95,6 +198,8 @@ def run(crash_log: bytes, table: SyscallTable, test_fn: TestFn,
     (ref repro.go:254-271); otherwise it is only verified to compile."""
     t0 = time.time()
     res = Result()
+    oracle = _as_oracle(test_fn)
+    test_fn = oracle.test
     suspects = extract_suspects(crash_log, table)
     if not suspects:
         log.logf(0, "repro: no programs in crash log")
@@ -104,12 +209,11 @@ def run(crash_log: bytes, table: SyscallTable, test_fn: TestFn,
 
     found: "M.Prog | None" = None
     for duration in (quick, thorough):
-        for p in suspects[:10]:
-            res.attempts += 1
-            if test_fn(P.serialize(p), opts, duration):
-                found = p
-                break
-        if found is not None:
+        items = [(P.serialize(p), opts) for p in suspects[:10]]
+        res.attempts += len(items)
+        hit = oracle.first_crasher(items, duration)
+        if hit is not None:
+            found = suspects[hit]
             break
     if found is None:
         res.duration = time.time() - t0

@@ -6,3 +6,5 @@ from syzkaller_tpu.vm.base import (  # noqa: F401
 from syzkaller_tpu.vm.monitor import Outcome, monitor_execution  # noqa: F401
 from syzkaller_tpu.vm import local  # noqa: F401  (registers "local")
 from syzkaller_tpu.vm import qemu  # noqa: F401   (registers "qemu")
+from syzkaller_tpu.vm import adb  # noqa: F401    (registers "adb")
+from syzkaller_tpu.vm import gce  # noqa: F401    (registers "gce")

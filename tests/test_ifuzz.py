@@ -127,3 +127,20 @@ def test_text_args_are_instruction_streams(r):
                         if mode is not None:
                             assert IF.decode_stream(res.data, mode) is not None
     assert found > 0
+
+
+def test_mutate_arm64_incremental(r):
+    code = IF.generate_arm64(r, nwords=8)
+    changed = False
+    for _ in range(40):
+        nxt = IF.mutate_arm64(r, code)
+        assert len(nxt) % 4 == 0 and len(nxt) > 0
+        # incremental: one word inserted/deleted/changed per step
+        assert abs(len(nxt) - len(code)) <= 4
+        # the multiset of words is mostly preserved
+        words = lambda c: [c[i:i+4] for i in range(0, len(c), 4)]
+        kept = len(set(words(code)) & set(words(nxt)))
+        assert kept >= len(words(code)) - 2
+        changed |= nxt != code
+        code = nxt
+    assert changed

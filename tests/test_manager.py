@@ -216,3 +216,31 @@ def test_manager_fuzzer_integration(tmp_path):
                           npcs=1 << 14, http=""))
     assert len(mgr2.candidates) >= ncorpus  # a few NewInputs can land after the stats snapshot
     mgr2.server.close()
+
+
+def test_hub_http_page(tmp_path):
+    """Hub status page (ref syz-hub/http.go): per-manager table +
+    pending counters, served over real HTTP."""
+    import urllib.request
+
+    from syzkaller_tpu import rpc as rpc_mod
+    from syzkaller_tpu.hub import http as hub_http
+    from syzkaller_tpu.hub.hub import Hub
+
+    hub = Hub(str(tmp_path / "hub"), key="k")
+    hub.serve_background()
+    srv = hub_http.serve(hub, "127.0.0.1", 0)
+    try:
+        cli = rpc_mod.RpcClient(hub.addr)
+        cli.call("Hub.Connect", {"name": "mgrA", "key": "k", "fresh": True})
+        cli.call("Hub.Sync", {"name": "mgrA", "key": "k",
+                              "add": [rpc_mod.b64(b"prog text")]})
+        url = "http://%s:%d/" % srv.server_address
+        page = urllib.request.urlopen(url, timeout=10).read().decode()
+        assert "syz-hub" in page and "mgrA" in page
+        assert "corpus 1" in page
+        assert urllib.request.urlopen(url + "log", timeout=10).status == 200
+        cli.close()
+    finally:
+        srv.shutdown()
+        hub.close()

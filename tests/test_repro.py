@@ -168,3 +168,39 @@ def test_repro_c_verification(table):
     assert result is not None and result.prog is not None
     assert len(ran) == 1 and not os.path.exists(ran[0])
     assert result.c_repro is None  # dropped: did not reproduce
+
+
+def test_repro_parallel_oracle(table):
+    """first_crasher drives multiple workers concurrently and prefers the
+    earliest crashing suspect (VERDICT r1 item #8: repro must use its
+    whole peeled-off VM pool, ref repro.go:61-116)."""
+    import threading
+    import time as time_mod
+
+    concurrency = {"now": 0, "max": 0}
+    mu = threading.Lock()
+    seen_wids = set()
+
+    class SlowOracle(repro_pkg.Oracle):
+        def __init__(self):
+            super().__init__(self._t, workers=4)
+
+        def _t(self, data, opts, duration):
+            return self._test_on(0, data, opts, duration)
+
+        def _test_on(self, wid, data, opts, duration):
+            with mu:
+                concurrency["now"] += 1
+                concurrency["max"] = max(concurrency["max"], concurrency["now"])
+                seen_wids.add(wid)
+            time_mod.sleep(0.2)
+            with mu:
+                concurrency["now"] -= 1
+            return CRASH_MARKER.encode() in data
+
+    oracle = SlowOracle()
+    result = repro_pkg.run(make_crash_log(table), table, oracle,
+                           quick=0.1, thorough=0.2)
+    assert result is not None and result.prog is not None
+    assert concurrency["max"] >= 2, "suspect scan did not parallelize"
+    assert len(seen_wids) >= 2, "only one worker instance used"
