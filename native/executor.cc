@@ -438,6 +438,145 @@ static long syz_emit_ethernet(uint64_t a0, uint64_t a1)
 	return res;
 }
 
+// syz_kvm_setup_cpu: build runnable guest CPU state so KVM_RUN executes
+// the fuzz text immediately (capability analog of reference
+// executor/common_kvm.h syz_kvm_setup_cpu; fresh implementation against
+// the KVM UAPI).  Guest physical layout inside the 24-page usermem:
+//   0x1000 PML4   0x2000 PDPT   0x3000 PD (one 2MB identity entry)
+//   0x4000 GDT    0x5000 IDT    0x6000..0x7000 stack    0x8000 text
+#if defined(__x86_64__) && __has_include(<linux/kvm.h>)
+#include <linux/kvm.h>
+
+static void kvm_flat_seg(struct kvm_segment* s, uint16_t sel, uint8_t type,
+			 int db, int l, uint32_t limit, int g)
+{
+	memset(s, 0, sizeof(*s));
+	s->selector = sel;
+	s->type = type;
+	s->present = 1;
+	s->s = 1;
+	s->db = db;
+	s->l = l;
+	s->limit = limit;
+	s->g = g;
+}
+
+static long syz_kvm_setup_cpu(uint64_t vmfd, uint64_t cpufd, uint64_t umem,
+			      uint64_t text_arr, uint64_t ntext,
+			      uint64_t setup_flags, uint64_t opts,
+			      uint64_t nopt)
+{
+	(void)opts;
+	(void)nopt;
+	const uint64_t kGuestPages = 24;
+	const uint64_t kTextGpa = 0x8000;
+	char* mem = (char*)umem;
+	if (!mem)
+		return -1;
+
+	struct kvm_userspace_memory_region reg;
+	memset(&reg, 0, sizeof(reg));
+	reg.slot = 0;
+	reg.guest_phys_addr = 0;
+	reg.memory_size = kGuestPages * 4096;
+	reg.userspace_addr = umem;
+	if (ioctl(vmfd, KVM_SET_USER_MEMORY_REGION, &reg))
+		return -1;
+
+	// first text entry: {mode int64, body ptr, size int64}
+	uint64_t mode = setup_flags & 3, text_ptr = 0, text_len = 0;
+	if (ntext) {
+		NONFAILING(mode = ((uint64_t*)text_arr)[0] & 3);
+		NONFAILING(text_ptr = ((uint64_t*)text_arr)[1]);
+		NONFAILING(text_len = ((uint64_t*)text_arr)[2]);
+	}
+	long copied = 0;
+	if (text_len > (kGuestPages - 8) * 4096)
+		text_len = (kGuestPages - 8) * 4096;
+	NONFAILING(memcpy(mem + kTextGpa, (void*)text_ptr, text_len),
+		   copied = 1);
+	(void)copied;
+
+	// flat GDT: null, code, data (entry layout per Intel SDM vol 3)
+	uint64_t* gdt = (uint64_t*)(mem + 0x4000);
+	gdt[0] = 0;
+	uint64_t code = 0x00009b000000ffffULL, data = 0x000093000000ffffULL;
+	if (mode == 2) { // prot32: G=1, D/B=1, limit 4GB
+		code |= (0xfULL << 48) | (1ULL << 55) | (1ULL << 54);
+		data |= (0xfULL << 48) | (1ULL << 55) | (1ULL << 54);
+	} else if (mode == 3) { // long64: L=1 on code
+		code |= 1ULL << 53;
+	}
+	gdt[1] = code;
+	gdt[2] = data;
+
+	if (mode == 3) { // identity-map 0..2MB with one huge PD entry
+		uint64_t* pml4 = (uint64_t*)(mem + 0x1000);
+		uint64_t* pdpt = (uint64_t*)(mem + 0x2000);
+		uint64_t* pd = (uint64_t*)(mem + 0x3000);
+		memset(pml4, 0, 4096);
+		memset(pdpt, 0, 4096);
+		memset(pd, 0, 4096);
+		pml4[0] = 0x2000 | 3;       // present|rw
+		pdpt[0] = 0x3000 | 3;
+		pd[0] = 0x80 | 3;           // 2MB page at 0
+	}
+	memset(mem + 0x5000, 0, 4096);      // IDT: all not-present
+
+	struct kvm_sregs sregs;
+	if (ioctl(cpufd, KVM_GET_SREGS, &sregs))
+		return -1;
+	sregs.gdt.base = 0x4000;
+	sregs.gdt.limit = 3 * 8 - 1;
+	sregs.idt.base = 0x5000;
+	sregs.idt.limit = 0;
+	switch (mode) {
+	case 0: // real16: reset-style segments, paging/protection off
+		sregs.cr0 &= ~1ULL;
+		kvm_flat_seg(&sregs.cs, 0, 0xb, 0, 0, 0xffff, 0);
+		kvm_flat_seg(&sregs.ds, 0, 0x3, 0, 0, 0xffff, 0);
+		break;
+	case 1: // prot16: protected mode, 16-bit segments
+		sregs.cr0 |= 1;
+		kvm_flat_seg(&sregs.cs, 8, 0xb, 0, 0, 0xffff, 0);
+		kvm_flat_seg(&sregs.ds, 16, 0x3, 0, 0, 0xffff, 0);
+		break;
+	case 2: // prot32: flat 4GB
+		sregs.cr0 |= 1;
+		kvm_flat_seg(&sregs.cs, 8, 0xb, 1, 0, 0xfffff, 1);
+		kvm_flat_seg(&sregs.ds, 16, 0x3, 1, 0, 0xfffff, 1);
+		break;
+	case 3: // long64: PAE paging + EFER.LME/LMA, 64-bit code seg
+		sregs.cr3 = 0x1000;
+		sregs.cr4 |= 1 << 5;                  // PAE
+		sregs.efer |= 0x500 | 1;              // LME|LMA|SCE
+		sregs.cr0 |= 0x80000001ULL;           // PG|PE
+		kvm_flat_seg(&sregs.cs, 8, 0xb, 0, 1, 0xfffff, 1);
+		kvm_flat_seg(&sregs.ds, 16, 0x3, 1, 0, 0xfffff, 1);
+		break;
+	}
+	sregs.es = sregs.ss = sregs.fs = sregs.gs = sregs.ds;
+	if (ioctl(cpufd, KVM_SET_SREGS, &sregs))
+		return -1;
+
+	struct kvm_regs regs;
+	memset(&regs, 0, sizeof(regs));
+	regs.rip = kTextGpa;
+	regs.rsp = 0x7000;
+	regs.rflags = 2;
+	if (ioctl(cpufd, KVM_SET_REGS, &regs))
+		return -1;
+	return 0;
+}
+#else
+static long syz_kvm_setup_cpu(uint64_t, uint64_t, uint64_t, uint64_t,
+			      uint64_t, uint64_t, uint64_t, uint64_t)
+{
+	errno = ENOSYS;
+	return -1;
+}
+#endif
+
 static long execute_pseudo(uint64_t nr, uint64_t a[9])
 {
 	switch (nr) {
@@ -452,7 +591,9 @@ static long execute_pseudo(uint64_t nr, uint64_t a[9])
 					 a[6], a[7]);
 	case kSyzEmitEthernet:
 		return syz_emit_ethernet(a[0], a[1]);
-	case kSyzKvmSetupCpu: // not implemented yet (needs ifuzz text args)
+	case kSyzKvmSetupCpu:
+		return syz_kvm_setup_cpu(a[0], a[1], a[2], a[3], a[4], a[5],
+					 a[6], a[7]);
 	default:
 		return 0;
 	}
