@@ -55,6 +55,8 @@ class Config:
     cpu: int = 1
     image_9p: bool = False
     boot_timeout: float = 600.0
+    # VM-type specific (lkvm)
+    lkvm: str = ""                     # lkvm binary override
     # VM-type specific (adb)
     devices: str = ""                  # comma-separated device serials
     console: str = ""                  # USB serial console (/dev/ttyUSB*)
@@ -105,6 +107,8 @@ class Config:
                 raise ConfigError(f"count {self.count} > {len(devs)} devices")
         if self.type == "gce" and not self.gce_image:
             raise ConfigError("gce requires gce_image")
+        if self.type in ("lkvm", "kvm") and not self.kernel:
+            raise ConfigError("lkvm requires kernel")
 
     def enabled_calls(self, table: SyscallTable) -> list[str]:
         """Apply enable/disable globs (ref config.go:183-229)."""

@@ -8,6 +8,7 @@ page (uptime, stats, crash table, per-call corpus counts), /corpus,
 from __future__ import annotations
 
 import html as html_mod
+import os
 import threading
 import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
@@ -45,6 +46,8 @@ def serve(mgr, host: str, port: int) -> ThreadingHTTPServer:
                     self._send(prio(mgr, q.get("call", [""])[0]))
                 elif u.path == "/cover":
                     self._send(cover(mgr, q.get("call", [""])[0]))
+                elif u.path == "/profile":
+                    self._send(profile(mgr, q.get("sec", ["3"])[0]))
                 elif u.path == "/log":
                     self._send("<pre>%s</pre>" %
                                html_mod.escape(log.cached_log()))
@@ -89,7 +92,8 @@ def summary(mgr) -> str:
             f"corpus <a href='/corpus'>{ncorpus}</a>, cover {cover}, "
             f"fuzzers {_esc(fuzzers)}</p>"
             f"<p><a href='/prio'>priorities</a> | "
-            f"<a href='/cover'>coverage</a> | <a href='/log'>log</a></p>"
+            f"<a href='/cover'>coverage</a> | "
+            f"<a href='/profile'>profile</a> | <a href='/log'>log</a></p>"
             f"<h3>Stats</h3><table>{rows}</table>"
             f"<h3>Crashes</h3><table><tr><th>description</th><th>count</th>"
             f"</tr>{crows}</table>")
@@ -162,6 +166,19 @@ def cover(mgr, call: str) -> str:
                     _cover_cache[key] = report
             body += report
     return body
+
+
+def profile(mgr, sec: str) -> str:
+    """Kick off a JAX profiler capture of the device engine while the
+    fuzzing pipeline keeps running (SURVEY §5 step-profiling hook)."""
+    from syzkaller_tpu.utils import profiler
+
+    seconds = min(max(float(sec or 3), 0.5), 60.0)
+    out = profiler.capture_async(
+        os.path.join(mgr.cfg.workdir, "profile"), seconds)
+    return (f"{_STYLE}<h2>profiling</h2>"
+            f"<p>capturing {seconds:g}s of device activity into "
+            f"<code>{_esc(out)}</code> (tensorboard-loadable)</p>")
 
 
 def prio(mgr, call: str) -> str:

@@ -1,0 +1,160 @@
+"""Continuous-deployment daemon: run the manager in a loop, restarting
+it whenever the kernel artifact or the framework source updates.
+
+Capability analog of reference syz-gce/syz-gce.go:4-8 + gce/gce.go,
+re-grounded for this build: instead of GCS archives + a Go rebuild, the
+pollers watch (a) the kernel/image files the manager boots (mtime/sha),
+and (b) the framework source tree (git HEAD when available, tree hash
+otherwise).  On change: stop the manager, re-run the presubmit gate,
+and start a fresh manager on the same workdir — the persistent corpus
+re-seeds it (SURVEY §5 checkpoint/resume).
+
+    python -m syzkaller_tpu.tools.ci -config manager.json [-poll 60]
+"""
+
+from __future__ import annotations
+
+import argparse
+import hashlib
+import os
+import subprocess
+import sys
+import time
+
+from syzkaller_tpu.manager import config as config_mod
+from syzkaller_tpu.utils import log
+
+
+def file_fingerprint(path: str) -> str:
+    """Cheap change detector: size+mtime (content hash for small files)."""
+    try:
+        st = os.stat(path)
+    except OSError:
+        return "missing"
+    if st.st_size < (1 << 20):
+        with open(path, "rb") as f:
+            return hashlib.sha1(f.read()).hexdigest()
+    return f"{st.st_size}:{st.st_mtime_ns}"
+
+
+def source_fingerprint(root: str) -> str:
+    """Framework-version detector: git HEAD if the tree is a checkout,
+    else a hash over source file mtimes."""
+    try:
+        r = subprocess.run(["git", "-C", root, "rev-parse", "HEAD"],
+                           capture_output=True, text=True, timeout=30)
+        if r.returncode == 0:
+            return r.stdout.strip()
+    except (OSError, subprocess.TimeoutExpired):
+        pass
+    h = hashlib.sha1()
+    for dirpath, _dirs, files in sorted(os.walk(root)):
+        if any(part.startswith(".") or part == "__pycache__"
+               for part in dirpath.split(os.sep)):
+            continue
+        for fn in sorted(files):
+            if fn.endswith((".py", ".cc", ".h", ".txt", ".const")):
+                p = os.path.join(dirpath, fn)
+                try:
+                    h.update(f"{p}:{os.stat(p).st_mtime_ns}".encode())
+                except OSError:
+                    pass
+    return h.hexdigest()
+
+
+class CiDaemon:
+    """start → watch → (on change) stop → gate → restart loop."""
+
+    def __init__(self, config_path: str, poll: float = 60.0,
+                 gate: bool = True):
+        self.config_path = config_path
+        self.cfg = config_mod.load(config_path)
+        self.poll = poll
+        self.gate = gate
+        self.root = os.path.dirname(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))))
+        self._proc: "subprocess.Popen | None" = None
+        self.restarts = 0
+
+    def fingerprints(self) -> dict:
+        fp = {"source": source_fingerprint(self.root),
+              "config": file_fingerprint(self.config_path)}
+        for field in ("kernel", "image", "initrd", "vmlinux"):
+            path = getattr(self.cfg, field, "")
+            if path:
+                fp[field] = file_fingerprint(path)
+        return fp
+
+    def run_gate(self) -> bool:
+        r = subprocess.run(
+            [sys.executable, "-m", "syzkaller_tpu.presubmit", "--quick"],
+            cwd=self.root)
+        return r.returncode == 0
+
+    def start_manager(self) -> None:
+        cmd = [sys.executable, "-m", "syzkaller_tpu.manager",
+               "-config", self.config_path]
+        log.logf(0, "ci: starting manager: %s", " ".join(cmd))
+        self._proc = subprocess.Popen(cmd, start_new_session=True)
+
+    def stop_manager(self) -> None:
+        if self._proc is None:
+            return
+        log.logf(0, "ci: stopping manager (pid %d)", self._proc.pid)
+        try:
+            os.killpg(self._proc.pid, 15)
+            self._proc.wait(timeout=60)
+        except (ProcessLookupError, subprocess.TimeoutExpired,
+                PermissionError):
+            try:
+                os.killpg(self._proc.pid, 9)
+            except (ProcessLookupError, PermissionError):
+                self._proc.kill()
+            self._proc.wait()
+        self._proc = None
+
+    def step(self, last_fp: dict) -> dict:
+        """One poll tick: restart on artifact change or manager death.
+        Returns the new fingerprint set."""
+        fp = self.fingerprints()
+        died = self._proc is not None and self._proc.poll() is not None
+        if fp != last_fp or died or self._proc is None:
+            why = ("manager died" if died else
+                   "first start" if self._proc is None and not self.restarts
+                   else "artifacts changed: " + ", ".join(
+                       k for k in fp if fp[k] != last_fp.get(k)))
+            log.logf(0, "ci: (re)deploying — %s", why)
+            self.stop_manager()
+            self.cfg = config_mod.load(self.config_path)  # pick up edits
+            if self.gate and not self.run_gate():
+                log.logf(0, "ci: presubmit gate FAILED; retrying next poll")
+                return fp
+            self.start_manager()
+            self.restarts += 1
+        return fp
+
+    def run(self, duration: "float | None" = None) -> None:
+        deadline = time.time() + duration if duration else None
+        fp: dict = {}
+        try:
+            while deadline is None or time.time() < deadline:
+                fp = self.step(fp)
+                time.sleep(self.poll)
+        finally:
+            self.stop_manager()
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("-config", required=True)
+    ap.add_argument("-poll", type=float, default=60.0)
+    ap.add_argument("-nogate", action="store_true",
+                    help="skip the presubmit gate on redeploy")
+    ap.add_argument("-v", type=int, default=0)
+    args = ap.parse_args(argv)
+    log.set_verbosity(args.v)
+    CiDaemon(args.config, poll=args.poll, gate=not args.nogate).run()
+
+
+if __name__ == "__main__":
+    main()

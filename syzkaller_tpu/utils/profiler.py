@@ -1,0 +1,56 @@
+"""Step profiling: on-demand JAX profiler traces of the device hot loop.
+
+SURVEY §5 names this as a required addition over the reference (whose
+observability is stats counters + logs): real step profiling of the
+device engine.  A capture wraps whatever device work runs during the
+window — the fuzzing pipeline keeps executing, so traces show the real
+production interleaving (and the log-before-run invariant is untouched:
+profiling changes no execution order)."""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+
+from syzkaller_tpu.utils import log
+
+_mu = threading.Lock()
+
+
+def capture(out_dir: str, seconds: float = 3.0) -> str:
+    """Trace all JAX activity for `seconds`; returns the trace dir
+    (tensorboard-loadable).  Serialized: one capture at a time."""
+    import jax
+
+    run_dir = os.path.join(out_dir, time.strftime("trace-%Y%m%d-%H%M%S"))
+    os.makedirs(run_dir, exist_ok=True)
+    with _mu:
+        log.logf(0, "profiler: capturing %gs into %s", seconds, run_dir)
+        jax.profiler.start_trace(run_dir)
+        try:
+            time.sleep(seconds)
+        finally:
+            jax.profiler.stop_trace()
+    return run_dir
+
+
+def capture_async(out_dir: str, seconds: float = 3.0) -> str:
+    """Fire-and-forget capture (for HTTP handlers); returns the dir the
+    trace will land in."""
+    run_dir = os.path.join(out_dir, time.strftime("trace-%Y%m%d-%H%M%S"))
+
+    def work():
+        import jax
+
+        os.makedirs(run_dir, exist_ok=True)
+        with _mu:
+            jax.profiler.start_trace(run_dir)
+            try:
+                time.sleep(seconds)
+            finally:
+                jax.profiler.stop_trace()
+        log.logf(0, "profiler: trace written to %s", run_dir)
+
+    threading.Thread(target=work, daemon=True).start()
+    return run_dir

@@ -8,3 +8,4 @@ from syzkaller_tpu.vm import local  # noqa: F401  (registers "local")
 from syzkaller_tpu.vm import qemu  # noqa: F401   (registers "qemu")
 from syzkaller_tpu.vm import adb  # noqa: F401    (registers "adb")
 from syzkaller_tpu.vm import gce  # noqa: F401    (registers "gce")
+from syzkaller_tpu.vm import lkvm  # noqa: F401   (registers "lkvm"/"kvm")

@@ -3,6 +3,8 @@ numpy sorted-set reference (strategy mirrors reference cover/cover_test.go:
 each set op vs a brute-force implementation on random inputs), plus the
 8-virtual-device sharded path (SURVEY §4 implication (d))."""
 
+import os
+
 import numpy as np
 import pytest
 
@@ -304,3 +306,31 @@ def test_sample_corpus_rows(rng):
     assert set(rows.tolist()) <= {0, 1}
     # popcount-weighted: the signal-rich row dominates
     assert (rows == 0).sum() > (rows == 1).sum()
+
+
+def test_profiler_capture(tmp_path, engine, rng):
+    """JAX profiler hook: a capture window around live engine work
+    produces a tensorboard-loadable trace (SURVEY §5 step profiling)."""
+    import threading
+
+    from syzkaller_tpu.utils import profiler
+
+    covers = [rand_cover(rng, 16) for _ in range(8)]
+    idx, valid = make_batch(covers)
+    stop = threading.Event()
+
+    def work():
+        while not stop.is_set():
+            engine.update_batch(np.zeros(8, np.int32), idx, valid)
+
+    t = threading.Thread(target=work, daemon=True)
+    t.start()
+    try:
+        out = profiler.capture(str(tmp_path), seconds=1.0)
+    finally:
+        stop.set()
+        t.join(timeout=10)
+    found = []
+    for dirpath, _d, files in os.walk(out):
+        found += [f for f in files if "trace" in f or f.endswith(".pb")]
+    assert found, f"no trace files under {out}"

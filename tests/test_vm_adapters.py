@@ -189,4 +189,152 @@ def test_gce_config_validation():
 def test_registry_has_all_adapters():
     from syzkaller_tpu import vm
 
-    assert {"local", "qemu", "adb", "gce"} <= set(vm.types())
+    assert {"local", "qemu", "adb", "gce", "lkvm", "kvm"} <= set(vm.types())
+
+
+# -- lkvm -------------------------------------------------------------------
+
+
+def test_lkvm_lifecycle(monkeypatch, tmp_path):
+    runs, popens = [], []
+    sandbox_path = None
+
+    def fake_run(argv, **kw):
+        runs.append(argv)
+        if "setup" in argv:
+            # lkvm setup creates the shared sandbox rootfs
+            import os
+            os.makedirs(sandbox_path, exist_ok=True)
+        return completed(argv)
+
+    class LkvmProc(FakeProc):
+        pass
+
+    def fake_popen(argv, **kw):
+        popens.append(argv)
+        # guest boot: consume /syz-cmd like the bootstrap poll loop does
+        import threading, time, os
+
+        def guest():
+            cmd = os.path.join(sandbox_path, "syz-cmd")
+            for _ in range(100):
+                if os.path.exists(cmd):
+                    os.remove(cmd)
+                time.sleep(0.05)
+
+        threading.Thread(target=guest, daemon=True).start()
+        return LkvmProc(argv)
+
+    from syzkaller_tpu.vm import lkvm as lkvm_mod
+
+    monkeypatch.setattr(lkvm_mod.subprocess, "run", fake_run)
+    monkeypatch.setattr(lkvm_mod.subprocess, "Popen", fake_popen)
+    monkeypatch.setattr(lkvm_mod.os, "killpg", lambda *a: None)
+    cfg = Config(workdir=str(tmp_path), type="lkvm", kernel="/k/bzImage",
+                 mem=512, cpu=2, boot_timeout=10.0)
+    import os as os_mod
+    sandbox_path = os_mod.path.join(os_mod.path.expanduser("~"),
+                                    ".lkvm", "syz-5")
+    inst = lkvm_mod.LkvmInstance(cfg, 5)
+    assert ["lkvm", "setup", "syz-5"] == runs[0]
+    boot = popens[0]
+    assert boot[:2] == ["lkvm", "sandbox"]
+    assert "--kernel" in boot and "/k/bzImage" in boot
+    assert ["--mem", "512"] == boot[boot.index("--mem"): boot.index("--mem") + 2]
+    # copy drops files into the shared rootfs
+    (tmp_path / "bin").write_bytes(b"x")
+    dst = inst.copy(str(tmp_path / "bin"))
+    assert dst == "/bin" and os_mod.path.exists(
+        os_mod.path.join(sandbox_path, "bin"))
+    assert inst.forward(5555) == "192.168.33.1:5555"
+    h = inst.run("echo hello", 5.0)
+    # the fake guest consumes the command file -> run completes
+    for _ in range(60):
+        if not h.is_alive():
+            break
+        import time as t
+        t.sleep(0.1)
+    assert not h.is_alive()
+    inst.close()
+    assert not os_mod.path.exists(sandbox_path)
+
+
+def test_lkvm_requires_kernel():
+    with pytest.raises(ConfigError, match="lkvm requires kernel"):
+        loads('{"type": "lkvm", "workdir": "/tmp/x"}')
+
+
+# -- ci daemon (syz-gce tier analog) ----------------------------------------
+
+
+def test_ci_daemon_redeploys_on_change(tmp_path, monkeypatch):
+    """The CI loop starts the manager, restarts it when a watched
+    artifact changes or the process dies, and re-gates each deploy
+    (ref syz-gce/syz-gce.go:4-8 behavior)."""
+    import json
+
+    from syzkaller_tpu.tools import ci as ci_mod
+
+    kernel = tmp_path / "bzImage"
+    kernel.write_bytes(b"v1")
+    cfgp = tmp_path / "mgr.json"
+    cfgp.write_text(json.dumps({
+        "workdir": str(tmp_path / "w"), "type": "qemu",
+        "kernel": str(kernel), "http": ""}))
+
+    started, stopped, gates = [], [], []
+
+    class P(FakeProc):
+        pass
+
+    daemon = ci_mod.CiDaemon(str(cfgp), poll=0.01, gate=True)
+    monkeypatch.setattr(daemon, "run_gate",
+                        lambda: gates.append(1) or True)
+    monkeypatch.setattr(daemon, "start_manager",
+                        lambda: started.append(1) or
+                        setattr(daemon, "_proc", P(["mgr"])))
+    real_stop = daemon.stop_manager
+    monkeypatch.setattr(daemon, "stop_manager",
+                        lambda: stopped.append(1) or
+                        setattr(daemon, "_proc", None))
+
+    fp = daemon.step({})
+    assert started == [1] and gates == [1]          # first start
+    fp2 = daemon.step(fp)
+    assert started == [1] and fp2 == fp             # steady state
+    kernel.write_bytes(b"v2-new-kernel")            # artifact update
+    fp3 = daemon.step(fp2)
+    assert started == [1, 1] and len(gates) == 2 and fp3 != fp2
+    daemon._proc._dead = True                       # manager death
+    daemon.step(fp3)
+    assert started == [1, 1, 1]
+    assert daemon.restarts == 3
+
+
+def test_ci_gate_failure_blocks_deploy(tmp_path, monkeypatch):
+    import json
+
+    from syzkaller_tpu.tools import ci as ci_mod
+
+    cfgp = tmp_path / "mgr.json"
+    cfgp.write_text(json.dumps({
+        "workdir": str(tmp_path / "w"), "type": "local", "http": ""}))
+    daemon = ci_mod.CiDaemon(str(cfgp), gate=True)
+    monkeypatch.setattr(daemon, "run_gate", lambda: False)
+    started = []
+    monkeypatch.setattr(daemon, "start_manager", lambda: started.append(1))
+    daemon.step({})
+    assert started == []                            # gate blocked it
+
+
+def test_ci_fingerprints(tmp_path):
+    from syzkaller_tpu.tools import ci as ci_mod
+
+    f = tmp_path / "a"
+    f.write_bytes(b"one")
+    fp1 = ci_mod.file_fingerprint(str(f))
+    f.write_bytes(b"two")
+    assert ci_mod.file_fingerprint(str(f)) != fp1
+    assert ci_mod.file_fingerprint(str(tmp_path / "missing")) == "missing"
+    s = ci_mod.source_fingerprint(str(tmp_path))
+    assert isinstance(s, str) and s
