@@ -87,6 +87,7 @@ class Fuzzer:
                 log.logf(0, "device signal unavailable (%s); using host sets", e)
         # (prog, call_index, canonical cover) awaiting a device verdict
         self._pending_sig: list[tuple] = []
+        self._corpus_rows: deque[int] = deque()  # device-drawn mutate picks
 
         n = self.table.count
         self.max_cover: list[np.ndarray] = [np.zeros(0, np.uint32)] * n
@@ -297,9 +298,12 @@ class Fuzzer:
             if self.signal is None:
                 self.corpus_cover[cid] = sets.union(self.corpus_cover[cid],
                                                     min_cover)
+            else:
+                # under the same lock as the append: device corpus rows
+                # stay index-aligned with self.corpus, which the
+                # weighted corpus-row sampler relies on
+                self.signal.merge_corpus(cid, min_cover)
             self.stats["new inputs"] += 1
-        if self.signal is not None:
-            self.signal.merge_corpus(cid, min_cover)
         self.client.call("Manager.NewInput", {
             "name": self.name,
             "call": item.prog.calls[item.call_index].meta.name,
@@ -354,7 +358,12 @@ class Fuzzer:
                     choice = (self.device_choices.popleft()
                               if self.device_choices else None)
                 if corpus and not rand.one_of(10):
-                    p = M.clone_prog(corpus[rand.intn(len(corpus))])
+                    # device mode: which program to mutate is a batched
+                    # popcount-weighted categorical over the corpus
+                    # signal matrix (BASELINE config #3); host mode:
+                    # uniform pick (ref fuzzer.go:224)
+                    row = self._pick_corpus_row(len(corpus), rand)
+                    p = M.clone_prog(corpus[row])
                     P.mutate(p, rand, self.table, PROG_NCALLS, self.ct, corpus)
                     stat = "exec fuzz"
                 else:
@@ -366,6 +375,24 @@ class Fuzzer:
                     self.check_new_signal(p, res)
         finally:
             env.close()
+
+    def _pick_corpus_row(self, ncorpus: int, rand: P.Rand) -> int:
+        """Corpus pick for mutation: device-drawn signal-weighted rows
+        (consumed from a cached batch, one jit call per ~256 picks) with
+        a uniform host fallback."""
+        if self.signal is not None:
+            with self._mu:
+                if not self._corpus_rows:
+                    try:
+                        rows = self.signal.engine.sample_corpus_rows(256)
+                        self._corpus_rows.extend(int(x) for x in rows)
+                    except Exception:
+                        pass
+                if self._corpus_rows:
+                    row = self._corpus_rows.popleft()
+                    if row < ncorpus:
+                        return row
+        return rand.intn(ncorpus)
 
     def generate_seeded(self, rand: P.Rand, choice: "int | None") -> M.Prog:
         """Generation; a device-drawn first call (from Poll) biases what
@@ -481,7 +508,7 @@ class Fuzzer:
                     return
                 self.corpus_hashes.add(h)
                 self.corpus.append(p)
-            self.signal.merge_corpus(call_id, cover)
+                self.signal.merge_corpus(call_id, cover)  # row-aligned
             self.signal.merge_max(call_id, cover)
             return
         with self._mu:
