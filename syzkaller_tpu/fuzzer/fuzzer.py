@@ -330,6 +330,13 @@ class Fuzzer:
     # -- proc loop (ref fuzzer.go:174-232) ---------------------------------
 
     def proc_loop(self, pid: int) -> None:
+        try:
+            self._proc_loop(pid)
+        except Exception as e:  # a dead proc must be visible, not silent
+            log.logf(0, "fuzzer proc %d died: %r", pid, e)
+            raise
+
+    def _proc_loop(self, pid: int) -> None:
         rand = P.Rand(np.random.default_rng(self.seed * 4096 + pid))
         env = ipc.Env(flags=self.flags, pid=pid)
         gate = self.gate

@@ -96,6 +96,13 @@ class Stress:
     # -- the per-proc loop (ref stress.go:62-88) ---------------------------
 
     def proc_loop(self, pid: int) -> None:
+        try:
+            self._proc_loop(pid)
+        except Exception as e:  # a dead proc must be visible, not silent
+            log.logf(0, "stress proc %d died: %r", pid, e)
+            raise
+
+    def _proc_loop(self, pid: int) -> None:
         rand = P.Rand(np.random.default_rng(self.opts.seed * 1000 + pid))
         if self.opts.device_rand:
             rand.refill(self.engine.random_words(1 << 16))
