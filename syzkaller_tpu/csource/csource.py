@@ -296,10 +296,8 @@ static void execute_prog(void) {
 """
 
 # Pinned pseudo-syscall numbers (syzkaller_tpu/sys/types.py PSEUDO_NRS);
-# the emitted helpers mirror native/executor.cc behavior.  kvm_setup_cpu
-# is not implemented yet, so it stays a no-op in repros too.
-_PSEUDO_NR_SET = frozenset(
-    v for k, v in T.PSEUDO_NRS.items() if k != "syz_kvm_setup_cpu")
+# the emitted helpers mirror native/executor.cc behavior.
+_PSEUDO_NR_SET = frozenset(T.PSEUDO_NRS.values())
 
 _PSEUDO_HELPERS = """
 #include <arpa/inet.h>
@@ -308,6 +306,9 @@ _PSEUDO_HELPERS = """
 #include <linux/if_tun.h>
 #include <net/if_arp.h>
 #include <sys/ioctl.h>
+#if defined(__x86_64__) && __has_include(<linux/kvm.h>)
+#include <linux/kvm.h>
+#endif
 #include <sys/socket.h>
 #include <sys/stat.h>
 #include <sys/sysmacros.h>
@@ -424,6 +425,61 @@ static long syz_pseudo(uint64_t nr, uint64_t a0, uint64_t a1, uint64_t a2,
 \t\tNONFAILING(res = write(tun_fd, (const void*)a0, a1));
 \t\treturn res;
 \t}
+#if defined(__x86_64__) && __has_include(<linux/kvm.h>)
+\tcase 1000006: { /* syz_kvm_setup_cpu (mirrors native/executor.cc) */
+\t\tchar* mem = (char*)a2;
+\t\tif (!mem) return -1;
+\t\tstruct kvm_userspace_memory_region reg;
+\t\tmemset(&reg, 0, sizeof(reg));
+\t\treg.memory_size = 24 * 4096;
+\t\treg.userspace_addr = a2;
+\t\tif (ioctl(a0, KVM_SET_USER_MEMORY_REGION, &reg)) return -1;
+\t\tuint64_t mode = a5 & 3, tp = 0, tl = 0;
+\t\tif (a4) { NONFAILING(mode = ((uint64_t*)a3)[0] & 3;
+\t\t\ttp = ((uint64_t*)a3)[1]; tl = ((uint64_t*)a3)[2]); }
+\t\tif (tl > 16 * 4096) tl = 16 * 4096;
+\t\tNONFAILING(memcpy(mem + 0x8000, (void*)tp, tl));
+\t\tuint64_t* gdt = (uint64_t*)(mem + 0x4000);
+\t\tuint64_t code = 0x00009b000000ffffULL, data = 0x000093000000ffffULL;
+\t\tif (mode == 2) { code |= (0xfULL << 48) | (3ULL << 54);
+\t\t\tdata |= (0xfULL << 48) | (3ULL << 54); }
+\t\telse if (mode == 3) code |= 1ULL << 53;
+\t\tgdt[0] = 0; gdt[1] = code; gdt[2] = data;
+\t\tif (mode == 3) {
+\t\t\tuint64_t* pml4 = (uint64_t*)(mem + 0x1000);
+\t\t\tuint64_t* pdpt = (uint64_t*)(mem + 0x2000);
+\t\t\tuint64_t* pd = (uint64_t*)(mem + 0x3000);
+\t\t\tmemset(pml4, 0, 4096); memset(pdpt, 0, 4096); memset(pd, 0, 4096);
+\t\t\tpml4[0] = 0x2000 | 3; pdpt[0] = 0x3000 | 3; pd[0] = 0x80 | 3;
+\t\t}
+\t\tmemset(mem + 0x5000, 0, 4096);
+\t\tstruct kvm_sregs sr;
+\t\tif (ioctl(a1, KVM_GET_SREGS, &sr)) return -1;
+\t\tsr.gdt.base = 0x4000; sr.gdt.limit = 23;
+\t\tsr.idt.base = 0x5000; sr.idt.limit = 0;
+\t\tmemset(&sr.cs, 0, sizeof(sr.cs));
+\t\tsr.cs.present = 1; sr.cs.s = 1; sr.cs.type = 0xb;
+\t\tsr.ds = sr.cs; sr.ds.type = 0x3;
+\t\tswitch (mode) {
+\t\tcase 0: sr.cr0 &= ~1ULL; sr.cs.limit = sr.ds.limit = 0xffff; break;
+\t\tcase 1: sr.cr0 |= 1; sr.cs.selector = 8; sr.ds.selector = 16;
+\t\t\tsr.cs.limit = sr.ds.limit = 0xffff; break;
+\t\tcase 2: sr.cr0 |= 1; sr.cs.selector = 8; sr.ds.selector = 16;
+\t\t\tsr.cs.db = sr.ds.db = 1; sr.cs.g = sr.ds.g = 1;
+\t\t\tsr.cs.limit = sr.ds.limit = 0xfffff; break;
+\t\tcase 3: sr.cr3 = 0x1000; sr.cr4 |= 1 << 5; sr.efer |= 0x501;
+\t\t\tsr.cr0 |= 0x80000001ULL; sr.cs.selector = 8; sr.ds.selector = 16;
+\t\t\tsr.cs.l = 1; sr.ds.db = 1; sr.cs.g = sr.ds.g = 1;
+\t\t\tsr.cs.limit = sr.ds.limit = 0xfffff; break;
+\t\t}
+\t\tsr.es = sr.ss = sr.fs = sr.gs = sr.ds;
+\t\tif (ioctl(a1, KVM_SET_SREGS, &sr)) return -1;
+\t\tstruct kvm_regs rg;
+\t\tmemset(&rg, 0, sizeof(rg));
+\t\trg.rip = 0x8000; rg.rsp = 0x7000; rg.rflags = 2;
+\t\treturn ioctl(a1, KVM_SET_REGS, &rg);
+\t}
+#endif
 \t}
 \treturn 0;
 }

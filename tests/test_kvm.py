@@ -90,11 +90,28 @@ def test_kvm_setup_cpu_executor(table):
     try:
         res = env.exec(p)
         per = res.per_call(len(p.calls))
-        assert per[3] is not None, "syz_kvm_setup_cpu did not execute"
+        setup_idx = next(i for i, c in enumerate(p.calls)
+                         if c.meta.name == "syz_kvm_setup_cpu")
+        assert per[setup_idx] is not None, "syz_kvm_setup_cpu did not execute"
         if os.path.exists("/dev/kvm"):
-            assert per[3].errno == 0, "kvm setup failed with /dev/kvm present"
+            assert per[setup_idx].errno == 0, \
+                "kvm setup failed with /dev/kvm present"
         # and the executor survives to run another program
         res2 = env.exec(p)
         assert res2 is not None
     finally:
         env.close()
+
+
+@pytest.mark.skipif(os.system("gcc --version > /dev/null 2>&1") != 0,
+                    reason="no gcc")
+def test_kvm_c_repro_compiles(table):
+    """C reproducers containing syz_kvm_setup_cpu carry a working helper
+    (mirroring the executor's guest bring-up) and compile -static."""
+    from syzkaller_tpu import csource
+
+    p = P.deserialize(KVM_PROG, table)
+    src = csource.generate(p, csource.Options())
+    assert "1000006" in src and "KVM_SET_SREGS" in src
+    binary = csource.build(src)
+    os.unlink(binary)
