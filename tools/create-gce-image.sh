@@ -1,0 +1,47 @@
+#!/bin/bash
+# Turn a local rootfs image (tools/create-image.sh output) + kernel into
+# a GCE-bootable image and register it, for the gce VM adapter and the
+# CI daemon.  Capability analog of the reference's create-gce-image.sh.
+#
+#   tools/create-gce-image.sh <rootfs.img> <bzImage> <image-name>
+
+set -eux
+
+IMG="${1:?rootfs image}"
+KERNEL="${2:?kernel bzImage}"
+NAME="${3:-syzkaller-tpu-image}"
+WORK="$(mktemp -d)"
+
+# GCE boots MBR disks: create a bootable disk with the kernel installed
+DISK="$WORK/disk.raw"
+dd if=/dev/zero of="$DISK" bs=1M count=4096
+parted -s "$DISK" mklabel msdos mkpart primary ext4 1MiB 100%
+LOOP="$(sudo losetup --show -fP "$DISK")"
+sudo mkfs.ext4 -F "${LOOP}p1"
+MNT="$WORK/mnt"
+mkdir -p "$MNT"
+sudo mount "${LOOP}p1" "$MNT"
+
+# rootfs + kernel + extlinux bootloader on the serial console
+sudo mount -o loop "$IMG" "$WORK/src" --mkdir
+sudo cp -a "$WORK/src/." "$MNT/."
+sudo umount "$WORK/src"
+sudo mkdir -p "$MNT/boot/extlinux"
+sudo cp "$KERNEL" "$MNT/boot/vmlinuz"
+printf 'DEFAULT linux\nLABEL linux\nKERNEL /boot/vmlinuz\nAPPEND root=/dev/sda1 console=ttyS0 earlyprintk=serial\n' \
+    | sudo tee "$MNT/boot/extlinux/extlinux.conf"
+sudo extlinux --install "$MNT/boot/extlinux"
+dd if=/usr/lib/EXTLINUX/mbr.bin of="$DISK" conv=notrunc bs=440 count=1
+
+sudo umount "$MNT"
+sudo losetup -d "$LOOP"
+
+# GCE wants a tar.gz containing disk.raw
+tar -C "$WORK" -czf "$WORK/image.tar.gz" disk.raw
+BUCKET="gs://${GCS_BUCKET:?set GCS_BUCKET}"
+gsutil cp "$WORK/image.tar.gz" "$BUCKET/$NAME.tar.gz"
+gcloud compute images delete "$NAME" --quiet || true
+gcloud compute images create "$NAME" --source-uri "$BUCKET/$NAME.tar.gz"
+
+rm -rf "$WORK"
+echo "gce image: $NAME (use as gce_image in the manager config)"

@@ -1,0 +1,64 @@
+#!/bin/bash
+# Build a minimal Debian rootfs image suitable for fuzzing under the
+# qemu adapter: passwordless root over serial + ssh, debugfs mounted for
+# KCOV/kmemleak, BPF JIT on, and a Python runtime for the in-VM fuzzer.
+# Capability analog of the reference's create-image.sh; this build's
+# guest additionally needs python3 + numpy (the fuzzer process is
+# Python) and the repo tree copied in by the manager at boot.
+#
+#   tools/create-image.sh [suite] [outdir]
+
+set -eux
+
+SUITE="${1:-bookworm}"
+OUT="${2:-.}"
+ROOT="$OUT/rootfs-$SUITE"
+IMG="$OUT/$SUITE.img"
+SSHDIR="$OUT/ssh"
+
+sudo rm -rf "$ROOT"
+mkdir -p "$ROOT"
+sudo debootstrap --include=openssh-server,python3,python3-numpy,gcc \
+    "$SUITE" "$ROOT"
+
+# passwordless root, serial getty, dhcp networking
+sudo sed -i '/^root/ { s/:x:/::/ }' "$ROOT/etc/passwd"
+printf '\nauto eth0\niface eth0 inet dhcp\n' \
+    | sudo tee -a "$ROOT/etc/network/interfaces"
+echo 'ttyS0' | sudo tee -a "$ROOT/etc/securetty" || true
+sudo mkdir -p "$ROOT/etc/systemd/system/serial-getty@ttyS0.service.d"
+printf '[Service]\nExecStart=\nExecStart=-/sbin/agetty -a root ttyS0 115200 vt100\n' \
+    | sudo tee "$ROOT/etc/systemd/system/serial-getty@ttyS0.service.d/autologin.conf"
+
+# kernel debug interfaces the fuzzer consumes
+echo 'debugfs /sys/kernel/debug debugfs defaults 0 0' \
+    | sudo tee -a "$ROOT/etc/fstab"
+{
+    echo 'debug.exception-trace = 0'
+    echo 'net.core.bpf_jit_enable = 1'
+    echo 'net.core.bpf_jit_harden = 2'
+    echo 'kernel.printk = 7 4 1 3'
+    echo 'kernel.panic_on_warn = 0'
+} | sudo tee -a "$ROOT/etc/sysctl.conf"
+
+# prompt-less root ssh with a dedicated key
+rm -rf "$SSHDIR"
+mkdir -p "$SSHDIR"
+ssh-keygen -f "$SSHDIR/id_rsa" -t rsa -N ''
+sudo mkdir -p "$ROOT/root/.ssh"
+sudo cp "$SSHDIR/id_rsa.pub" "$ROOT/root/.ssh/authorized_keys"
+echo 'PermitRootLogin prohibit-password' \
+    | sudo tee -a "$ROOT/etc/ssh/sshd_config"
+
+# pack into a raw ext4 image
+dd if=/dev/zero of="$IMG" bs=1M count=2048
+mkfs.ext4 -F "$IMG"
+MNT="$(mktemp -d)"
+sudo mount -o loop "$IMG" "$MNT"
+sudo cp -a "$ROOT/." "$MNT/."
+sudo umount "$MNT"
+rmdir "$MNT"
+
+echo "image: $IMG"
+echo "ssh key: $SSHDIR/id_rsa"
+echo "manager config: {\"type\": \"qemu\", \"image\": \"$IMG\", \"sshkey\": \"$SSHDIR/id_rsa\", ...}"
