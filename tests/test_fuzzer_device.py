@@ -143,9 +143,9 @@ def test_fuzzer_device_integration(tmp_path):
     assert "-device" in mgr.fuzzer_cmdline(0, "127.0.0.1:1")
     # generous duration: the fuzzer subprocess pays jax import + engine
     # compile (~15s on CPU) before its first flush
-    t = threading.Thread(target=mgr.run, kwargs={"duration": 45.0})
+    t = threading.Thread(target=mgr.run, kwargs={"duration": 60.0})
     t.start()
-    t.join(timeout=150.0)
+    t.join(timeout=180.0)
     assert not t.is_alive()
     with mgr._mu:
         execs = mgr.stats.get("exec total", 0)
