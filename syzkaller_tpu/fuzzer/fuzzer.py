@@ -87,6 +87,8 @@ class Fuzzer:
                 log.logf(0, "device signal unavailable (%s); using host sets", e)
         # (prog, call_index, canonical cover) awaiting a device verdict
         self._pending_sig: list[tuple] = []
+        self._sig_mu = threading.Lock()          # submit-order pipeline
+        self._inflight_sig: "tuple | None" = None
         self._corpus_rows: deque[int] = deque()  # device-drawn mutate picks
 
         n = self.table.count
@@ -222,24 +224,42 @@ class Fuzzer:
 
     def flush_signal(self, force: bool = False) -> None:
         """Drain pending exec covers through device update steps; execs
-        with new signal enter the triage queue (ref fuzzer.go:460-478)."""
+        with new signal enter the triage queue (ref fuzzer.go:460-478).
+        Pipelined: each batch is SUBMITTED (async dispatch) and the
+        verdict of the previously submitted batch is resolved afterwards,
+        so the tunnel round-trip overlaps with executor work — triage
+        admission lags by one flush, which the reference's async triage
+        queue already tolerates."""
         if self.signal is None:
             return
         while True:
             with self._mu:
                 if not self._pending_sig:
-                    return
+                    break
                 if len(self._pending_sig) < self.signal.B and not force:
-                    return
+                    break
                 batch = self._pending_sig[: self.signal.B]
                 self._pending_sig = self._pending_sig[self.signal.B:]
             entries = [(p.calls[ci].meta.id, cov) for p, ci, cov in batch]
-            has_new = self.signal.check_batch(entries)
-            with self._mu:
-                for (p, ci, cov), new in zip(batch, has_new):
-                    if new:
-                        self.triage_q.append(TriageItem(
-                            prog=M.clone_prog(p), call_index=ci, cover=cov))
+            with self._sig_mu:
+                ticket = self.signal.submit_batch(entries)
+                prev, self._inflight_sig = self._inflight_sig, (batch, ticket)
+            self._resolve_flush(prev)
+        if force:
+            with self._sig_mu:
+                prev, self._inflight_sig = self._inflight_sig, None
+            self._resolve_flush(prev)
+
+    def _resolve_flush(self, inflight) -> None:
+        if inflight is None:
+            return
+        batch, ticket = inflight
+        has_new = self.signal.resolve(ticket)
+        with self._mu:
+            for (p, ci, cov), new in zip(batch, has_new):
+                if new:
+                    self.triage_q.append(TriageItem(
+                        prog=M.clone_prog(p), call_index=ci, cover=cov))
 
     # -- triage (ref fuzzer.go:377-454) ------------------------------------
 
@@ -299,10 +319,11 @@ class Fuzzer:
                 self.corpus_cover[cid] = sets.union(self.corpus_cover[cid],
                                                     min_cover)
             else:
-                # under the same lock as the append: device corpus rows
-                # stay index-aligned with self.corpus, which the
-                # weighted corpus-row sampler relies on
-                self.signal.merge_corpus(cid, min_cover)
+                # the device row records its corpus index so the
+                # weighted corpus-row sampler maps back to the right
+                # program even after chunked/full-matrix admissions
+                self.signal.merge_corpus(cid, min_cover,
+                                         corpus_index=len(self.corpus) - 1)
             self.stats["new inputs"] += 1
         self.client.call("Manager.NewInput", {
             "name": self.name,
@@ -391,7 +412,7 @@ class Fuzzer:
             with self._mu:
                 if not self._corpus_rows:
                     try:
-                        rows = self.signal.engine.sample_corpus_rows(256)
+                        rows = self.signal.sample_corpus_indices(256)
                         self._corpus_rows.extend(int(x) for x in rows)
                     except Exception:
                         pass
@@ -515,7 +536,8 @@ class Fuzzer:
                     return
                 self.corpus_hashes.add(h)
                 self.corpus.append(p)
-                self.signal.merge_corpus(call_id, cover)  # row-aligned
+                self.signal.merge_corpus(call_id, cover,
+                                         corpus_index=len(self.corpus) - 1)
             self.signal.merge_max(call_id, cover)
             return
         with self._mu:

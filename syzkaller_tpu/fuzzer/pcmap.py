@@ -9,6 +9,12 @@ overflow region instead of being dropped, so signal is degraded
 gracefully rather than lost (modules/KASLR case) — `overflow_hits`
 counts how often, so the degradation is visible in stats instead of
 silently aliasing (round-1 verdict weak item #5).
+
+The map is a vectorized open-addressing hash table in numpy: lookups
+and first-sight assignment for a whole batch of covers are a handful of
+array passes (linear probing, each round fully vectorized), not a
+per-PC Python loop — the round-2 verdict found the dict loop here was
+the host boundary that made the device pipeline lose to CPU end-to-end.
 """
 
 from __future__ import annotations
@@ -16,6 +22,14 @@ from __future__ import annotations
 import threading
 
 import numpy as np
+
+_MULT = np.uint64(0x9E3779B97F4A7C15)   # Fibonacci hashing multiplier
+_EMPTY = np.uint64(0xFFFFFFFFFFFFFFFF)  # hash-slot empty sentinel
+
+
+def _mix(keys: np.ndarray) -> np.ndarray:
+    h = keys * _MULT
+    return h ^ (h >> np.uint64(31))
 
 
 class PcMap:
@@ -27,76 +41,204 @@ class PcMap:
         self.npcs = npcs
         self.direct_cap = npcs - reserve_overflow
         self.overflow = reserve_overflow
-        self._map: dict[int, int] = {}
-        self._rev: list[int] = []          # direct index -> PC
         self._mu = threading.Lock()
         self.overflow_hits = 0             # lookups landing in overflow
+        # open-addressing table, ≥2x direct capacity → load factor ≤ 0.5
+        # (only direct-mapped PCs are stored; overflow PCs are computed
+        # per lookup, exactly like the original dict-based map)
+        size = 1024
+        while size < 2 * npcs:
+            size <<= 1
+        self._mask = np.uint64(size - 1)
+        self._keys = np.full(size, _EMPTY, np.uint64)
+        self._vals = np.zeros(size, np.int32)
+        self._rev = np.zeros(self.direct_cap, np.uint64)  # idx -> PC
+        self._n = 0
 
     def __len__(self) -> int:
-        return len(self._map)
+        return self._n
+
+    # -- vectorized core (all under self._mu) ------------------------------
+
+    def _lookup(self, uniq: np.ndarray) -> np.ndarray:
+        """Existing vals for unique keys; -1 where absent."""
+        n = len(uniq)
+        vals = np.full(n, -1, np.int32)
+        if n == 0 or self._n == 0:
+            return vals
+        h = _mix(uniq)
+        pend = np.arange(n)
+        r = np.zeros(n, np.uint64)
+        while len(pend):
+            slot = ((h[pend] + r[pend]) & self._mask).astype(np.int64)
+            k = self._keys[slot]
+            hit = k == uniq[pend]
+            vals[pend[hit]] = self._vals[slot[hit]]
+            cont = ~(hit | (k == _EMPTY))
+            r[pend[cont]] += np.uint64(1)
+            pend = pend[cont]
+        return vals
+
+    def _insert(self, keys: np.ndarray) -> np.ndarray:
+        """Assign sequential direct indices to unique absent keys (given
+        in first-seen order) and hash-insert them.  Caller guarantees
+        room: len(keys) <= direct_cap - _n."""
+        n = len(keys)
+        vals = np.arange(self._n, self._n + n, dtype=np.int32)
+        self._rev[self._n:self._n + n] = keys
+        self._n += n
+        h = _mix(keys)
+        pend = np.arange(n)
+        r = np.zeros(n, np.uint64)
+        while len(pend):
+            slot = ((h[pend] + r[pend]) & self._mask).astype(np.int64)
+            empty = self._keys[slot] == _EMPTY
+            es, ep = slot[empty], pend[empty]
+            if len(ep):
+                # two keys can race for one empty slot: first wins, the
+                # rest re-probe after the write
+                uslot, first = np.unique(es, return_index=True)
+                win = ep[first]
+                self._keys[uslot] = keys[win]
+                self._vals[uslot] = vals[win]
+            placed = self._keys[slot] == keys[pend]
+            cont = ~placed
+            r[pend[cont]] += np.uint64(1)
+            pend = pend[cont]
+        return vals
+
+    def _map_flat_locked(self, pcs: np.ndarray) -> np.ndarray:
+        """Per-occurrence indices for a flat raw-PC array (vectorized
+        lookup-or-assign; duplicates preserved).  Steady state (all PCs
+        already mapped) is a pure probe pass — the np.unique sort runs
+        only over first-sight misses."""
+        if len(pcs) == 0:
+            return np.empty(0, np.int32)
+        pcs = np.where(pcs == _EMPTY, _EMPTY - np.uint64(1), pcs)
+        out = self._lookup(pcs)
+        miss = out < 0
+        if miss.any():
+            mpcs = pcs[miss]
+            uniq, first = np.unique(mpcs, return_index=True)
+            order = np.argsort(first, kind="stable")        # first-seen
+            mkeys = uniq[order]
+            room = max(self.direct_cap - self._n, 0)
+            mvals = np.empty(len(mkeys), np.int32)
+            mvals[:room] = self._insert(mkeys[:room])
+            if len(mkeys) > room:
+                # overflow: stable hash into the reserved tail, not
+                # memoized (matches the original map's behavior; hits
+                # are counted per occurrence below)
+                ov = mkeys[room:]
+                mvals[room:] = (self.direct_cap
+                                + (ov % np.uint64(self.overflow))
+                                ).astype(np.int32)
+            # scatter back through each miss occurrence
+            back = np.empty(len(uniq), np.int32)
+            back[order] = mvals
+            pos = np.searchsorted(uniq, mpcs)
+            out[miss] = back[pos]
+        self.overflow_hits += int((out >= self.direct_cap).sum())
+        return out
+
+    # -- public API --------------------------------------------------------
+
+    def map_flat(self, pcs) -> np.ndarray:
+        """Flat raw-PC array → per-occurrence bitmap indices."""
+        with self._mu:
+            return self._map_flat_locked(np.asarray(pcs, np.uint64))
 
     def preseed(self, pcs) -> None:
         """Pre-assign indices for a known PC universe (vmlinux scan):
         restart-stable, and real-kernel PCs never overflow."""
-        with self._mu:
-            for pc in pcs:
-                self._index_of_locked(int(pc))
+        if not isinstance(pcs, np.ndarray):
+            pcs = np.array(list(pcs), np.uint64)   # C-speed conversion
+        self.map_flat(pcs)
 
     def index_of(self, pc: int) -> int:
-        with self._mu:
-            return self._index_of_locked(pc)
-
-    def _index_of_locked(self, pc: int) -> int:
-        idx = self._map.get(pc)
-        if idx is None:
-            if len(self._rev) < self.direct_cap:
-                idx = len(self._rev)
-                self._map[pc] = idx
-                self._rev.append(pc)
-            else:
-                # overflow: stable hash into the reserved tail
-                self.overflow_hits += 1
-                idx = self.direct_cap + (hash(pc) % self.overflow)
-        return idx
+        return int(self.map_flat(np.array([pc], np.uint64))[0])
 
     def indices_of(self, pcs) -> np.ndarray:
         """Per-PC indices (duplicates NOT removed — aliased PCs share)."""
-        with self._mu:
-            return np.array([self._index_of_locked(int(pc)) for pc in pcs],
-                            dtype=np.int64)
+        return self.map_flat(pcs).astype(np.int64)
 
     def pc_of(self, idx: int) -> "int | None":
         """Direct index -> PC (None for overflow/unassigned indices)."""
         with self._mu:
-            return self._rev[idx] if 0 <= idx < len(self._rev) else None
+            return int(self._rev[idx]) if 0 <= idx < self._n else None
 
     def pcs_of(self, indices) -> np.ndarray:
         """Bitmap indices -> known PCs (overflow indices dropped)."""
+        idx = np.asarray(indices, np.int64)
         with self._mu:
-            return np.array([self._rev[i] for i in indices
-                             if 0 <= i < len(self._rev)], dtype=np.uint64)
+            idx = idx[(idx >= 0) & (idx < self._n)]
+            return self._rev[idx].astype(np.uint64)
+
+    def map_rows(self, covers: "list[np.ndarray]", K: int,
+                 chunk: bool = False, pad_rows: int = 1
+                 ) -> tuple[np.ndarray, np.ndarray, np.ndarray]:
+        """List of raw-PC arrays → padded (R, K) index rows + mask +
+        (R,) owner (source cover per row, -1 = padding).  With
+        chunk=False covers longer than K are truncated at K (the tail is
+        the rarely-hit part after sort-dedup; reference caps at 64k
+        PCs/call too) and R = len(covers); with chunk=True each cover
+        spreads over ceil(len/K) rows of the same owner and R rounds up
+        to a multiple of pad_rows (keeps the set of compiled batch
+        shapes O(1)).  Valid entries are guaranteed duplicate-free per
+        row — distinct PCs can collide in the hashed overflow region,
+        and the engine's MXU bit-packing requires unique indices per row
+        (duplicates would carry).  One vectorized pipeline serves both
+        call modes: map_flat over the concatenation, one (row, col)
+        scatter, one sort-based in-row dedup."""
+        ncov = len(covers)
+        if chunk:
+            flat = [np.asarray(c, np.uint64).ravel() for c in covers]
+        else:
+            flat = [np.asarray(c[:K], np.uint64).ravel() for c in covers]
+        lens = np.array([len(t) for t in flat], np.int64)
+        nch = (np.maximum(1, -(-lens // K)) if chunk
+               else np.ones(ncov, np.int64))
+        rows = int(nch.sum()) if ncov else 0
+        R = max(pad_rows, (rows + pad_rows - 1) // pad_rows * pad_rows)
+        idx = np.zeros((R, K), np.int32)
+        valid = np.zeros((R, K), bool)
+        owner = np.full((R,), -1, np.int32)
+        if ncov == 0:
+            return idx, valid, owner
+        owner[:rows] = np.repeat(np.arange(ncov, dtype=np.int32), nch)
+        total = int(lens.sum())
+        if total:
+            vals = self.map_flat(np.concatenate(flat))
+            cover_id = np.repeat(np.arange(ncov), lens)
+            pos = np.arange(total) - np.repeat(
+                np.concatenate([[0], np.cumsum(lens)[:-1]]), lens)
+            row_start = np.concatenate([[0], np.cumsum(nch)[:-1]])
+            r = row_start[cover_id] + pos // K
+            c = pos % K
+            idx[r, c] = vals
+            valid[r, c] = True
+            _dedup_rows(idx, valid)
+        return idx, valid, owner
 
     def map_batch(self, covers: "list[np.ndarray]", K: int
                   ) -> tuple[np.ndarray, np.ndarray]:
-        """List of raw-PC arrays → padded (B, K) index batch + mask.
-        Covers longer than K are truncated (the tail is the rarely-hit
-        part after sort-dedup; reference caps at 64k PCs/call too).
-        Rows are guaranteed duplicate-free — distinct PCs can collide in
-        the hashed overflow region, and the engine's MXU bit-packing
-        requires unique indices per row (duplicates would carry)."""
-        B = len(covers)
-        idx = np.zeros((B, K), np.int32)
-        valid = np.zeros((B, K), bool)
-        with self._mu:
-            for i, cov in enumerate(covers):
-                seen: set[int] = set()
-                n = 0
-                for pc in cov[:K]:
-                    j = self._index_of_locked(int(pc))
-                    if j in seen:
-                        continue
-                    seen.add(j)
-                    idx[i, n] = j
-                    n += 1
-                valid[i, :n] = True
+        """List of raw-PC arrays → padded (B, K) index batch + mask,
+        one row per cover (truncating at K)."""
+        if len(covers) == 0:
+            return np.zeros((0, K), np.int32), np.zeros((0, K), bool)
+        idx, valid, _owner = self.map_rows(covers, K)
         return idx, valid
+
+
+def _dedup_rows(idx: np.ndarray, valid: np.ndarray) -> None:
+    """Mask duplicate indices within each row (in place), vectorized:
+    sort each row with invalids pushed to +inf, mark repeats, scatter the
+    dup mask back to original positions."""
+    s = np.where(valid, idx, np.int32(0x7FFFFFFF))
+    order = np.argsort(s, axis=1, kind="stable")
+    ss = np.take_along_axis(s, order, axis=1)
+    dup_sorted = np.zeros_like(valid)
+    dup_sorted[:, 1:] = (ss[:, 1:] == ss[:, :-1]) & (ss[:, 1:] != 0x7FFFFFFF)
+    dup = np.zeros_like(valid)
+    np.put_along_axis(dup, order, dup_sorted, axis=1)
+    valid &= ~dup
