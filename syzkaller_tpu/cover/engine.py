@@ -54,19 +54,24 @@ def pack_pcs(pc_idx: jax.Array, valid: jax.Array, npcs: int,
     """(B, K) int32 PC indices + mask → (B, W) uint32 packed bitmaps.
     Invalid/masked indices are dropped.
 
-    MXU formulation — no gather/scatter (measured at only ~120M random
-    elems/s on TPU, the old bottleneck): factor each word index as
-    (hi, lo) with 64 words per hi-group and split each word into 4 byte
-    planes, build two small one-hots, and let ONE batched bf16 matmul
-    accumulate the bits:  M[b,hi,col] = Σ_k onehot_hi × (onehot_col ·
-    2^bit_in_byte).  Byte sums ≤ 255 are exact in bf16/f32, so
-    recombining the 4 planes with integer shifts reproduces the exact
-    uint32 words.  Requires each row's indices to be unique (duplicate
-    bits would ADD) — per-exec covers are already sort-deduped by the
-    executor/PcMap; pass assume_unique=False to sort-dedup here."""
+    MXU formulation — no gather/scatter (measured at only ~25M random
+    elems/s on this backend, the old bottleneck): factor each word index
+    as (hi, lo) with 64 words per hi-group and split each word into 5
+    planes of ≤7 bits, build two small int8 one-hots, and let ONE
+    batched s8×s8→s32 matmul accumulate the bits:  M[b,hi,col] =
+    Σ_k onehot_hi × (onehot_col · 2^bit_in_plane).  Plane sums ≤ 127
+    are exact in int8×int8→int32, so recombining the 5 planes with
+    integer shifts reproduces the exact uint32 words.  (The 7-bit plane
+    split keeps every one-hot value ≤ 64 so the operands fit int8 —
+    int8 one-hots halve the materialized-operand HBM traffic vs bf16,
+    which dominates this kernel's cost.)  Requires each row's indices
+    to be unique (duplicate bits would ADD) — per-exec covers are
+    already sort-deduped by the executor/PcMap; pass
+    assume_unique=False to sort-dedup here."""
     B, K = pc_idx.shape
     W = nwords_for(npcs)
-    HI, COL = W // 64, 256
+    HI, NPL = W // 64, 5
+    COL = 64 * NPL
     ok = valid & (pc_idx >= 0) & (pc_idx < npcs)
     if assume_unique:
         s = jnp.where(ok, pc_idx, jnp.int32(npcs))
@@ -79,18 +84,21 @@ def pack_pcs(pc_idx: jax.Array, valid: jax.Array, npcs: int,
     word = s >> 5
     sub = s & 31
     hi = word >> 6
-    col = (word & 63) * 4 + (sub >> 3)
-    bitv = (jnp.uint32(1) << (sub & 7).astype(jnp.uint32)).astype(jnp.bfloat16)
+    plane = jnp.minimum(sub // 7, 4)       # bit planes 0-6,7-13,...,28-31
+    inplane = sub - plane * 7
+    col = (word & 63) * NPL + plane
+    bitv = (jnp.int32(1) << inplane).astype(jnp.int8)
     onehot_hi = ((hi[:, :, None] == jnp.arange(HI)[None, None, :])
-                 & keep[:, :, None])
+                 & keep[:, :, None]).astype(jnp.int8)
     onehot_col = jnp.where(
         (col[:, :, None] == jnp.arange(COL)[None, None, :])
-        & keep[:, :, None], bitv[:, :, None], 0).astype(jnp.bfloat16)
-    M = jnp.einsum("bkh,bkc->bhc", onehot_hi.astype(jnp.bfloat16),
-                   onehot_col, preferred_element_type=jnp.float32)
-    planes = M.reshape(B, HI, 64, 4).astype(jnp.uint32)
-    words = (planes[..., 0] | (planes[..., 1] << 8)
-             | (planes[..., 2] << 16) | (planes[..., 3] << 24))
+        & keep[:, :, None], bitv[:, :, None], 0).astype(jnp.int8)
+    M = jax.lax.dot_general(onehot_hi, onehot_col,
+                            (((1,), (1,)), ((0,), (0,))),
+                            preferred_element_type=jnp.int32)
+    planes = M.reshape(B, HI, 64, NPL).astype(jnp.uint32)
+    words = (planes[..., 0] | (planes[..., 1] << 7) | (planes[..., 2] << 14)
+             | (planes[..., 3] << 21) | (planes[..., 4] << 28))
     return words.reshape(B, W)
 
 
@@ -107,27 +115,99 @@ def scatter_or(base: jax.Array, call_ids: jax.Array,
     return jax.lax.fori_loop(0, call_ids.shape[0], body, base)
 
 
-def diff_merge(base: jax.Array, call_ids: jax.Array, bitmaps: jax.Array
-               ) -> tuple[jax.Array, jax.Array, jax.Array]:
+def diff_merge(base: jax.Array, call_ids: jax.Array, bitmaps: jax.Array,
+               group: int = 32) -> tuple[jax.Array, jax.Array, jax.Array]:
     """Diff-then-merge over the batch: row i's new-signal is computed
     against base ∪ rows[0..i) of the same call, so two identical
     new-coverage execs in one batch yield exactly one has_new verdict
     (matching the reference, which processes execs one at a time).
 
-    Fully vectorized: stable-sort rows by call id (runs become
-    contiguous), build the EXCLUSIVE per-segment prefix-OR with log2(B)
-    Hillis-Steele doubling passes over the (B, W) matrix, then one
-    row-gather of base and one scatter of each run's final OR.  The
-    previous per-row lax.scan serialized B tiny steps and dominated the
-    step time (~3ms at B=256); this is ~10 elementwise passes.
+    Fully vectorized, TWO-LEVEL: stable-sort rows by call id (runs
+    become contiguous), build the EXCLUSIVE per-segment prefix-OR
+    within groups of `group` rows (log2 G Hillis-Steele passes over the
+    (B, W) matrix), then chain group tails with a segmented scan over
+    the (B/G, W) tail matrix whose flag is `boundary-linked AND group
+    is single-run` (a tail may only flow through a group that belongs
+    entirely to the same run), and apply each group's carry to its
+    leading run.  log2(G) + 1 full-width passes instead of log2(B) —
+    at B=2048 that is 6 passes instead of 11, and the big-batch/large-W
+    configs are bandwidth-bound on exactly these passes.
     Returns (merged base, (B, W) new bitmaps, (B,) has_new)."""
     B, W = bitmaps.shape
     order = jnp.argsort(call_ids, stable=True)
     cid_s = call_ids[order]
     bm_s = bitmaps[order]
 
-    # pre_i = bm_{i-1} if same segment else 0; its inclusive segmented
-    # scan is exactly the exclusive prefix-OR of bm within the segment
+    G = group
+    if B % G or B <= G:
+        excl = _seg_prefix_or_flat(cid_s, bm_s)
+    else:
+        Bg = B // G
+        cg = cid_s.reshape(Bg, G)
+        bg = bm_s.reshape(Bg, G, W)
+        # within-group exclusive segmented prefix-OR
+        same_prev = jnp.concatenate(
+            [jnp.zeros((Bg, 1), bool), cg[:, 1:] == cg[:, :-1]], axis=1)
+        pre = jnp.where(
+            same_prev[:, :, None],
+            jnp.concatenate([jnp.zeros((Bg, 1, W), bm_s.dtype), bg[:, :-1]],
+                            axis=1),
+            jnp.uint32(0))
+        excl = pre
+        s = 1
+        while s < G:
+            sh = jnp.concatenate(
+                [jnp.zeros((Bg, min(s, G), W), excl.dtype), excl[:, :-s]],
+                axis=1)[:, :G]
+            sm = jnp.concatenate(
+                [jnp.zeros((Bg, min(s, G)), bool), cg[:, s:] == cg[:, :-s]],
+                axis=1)[:, :G]
+            excl = jnp.where(sm[:, :, None], excl | sh, excl)
+            s *= 2
+        # group tails: OR of each group's trailing run
+        tail = excl[:, -1] | bg[:, -1]
+        cid_last = cg[:, -1]
+        link = jnp.concatenate(
+            [jnp.zeros((1,), bool), cid_last[:-1] == cg[1:, 0]])
+        pure = cg[:, 0] == cg[:, -1]
+        # segmented inclusive scan of tails; flag = link & pure (a tail
+        # may only pass THROUGH a group that is one single run)
+        flag = link & pure
+        u = tail
+        s = 1
+        Bg_ = Bg
+        while s < Bg_:
+            sh = jnp.concatenate(
+                [jnp.zeros((min(s, Bg_), W), u.dtype), u[:-s]], axis=0)[:Bg_]
+            u = jnp.where(flag[:, None], u | sh, u)
+            flag = flag & jnp.concatenate(
+                [jnp.zeros((min(s, Bg_),), bool), flag[:-s]])[:Bg_]
+            s *= 2
+        carry = jnp.where(
+            link[:, None],
+            jnp.concatenate([jnp.zeros((1, W), u.dtype), u[:-1]], axis=0),
+            jnp.uint32(0))
+        lead = cg == cg[:, :1]
+        excl = jnp.where(lead[:, :, None], excl | carry[:, None, :],
+                         excl).reshape(B, W)
+
+    prev = jnp.bitwise_or(base[cid_s], excl)
+    new_s = jnp.bitwise_and(bm_s, jnp.bitwise_not(prev))
+    full = jnp.bitwise_or(prev, bm_s)
+    # one scatter per segment: the last row of each run holds base|seg-OR
+    last = jnp.concatenate([cid_s[1:] != cid_s[:-1], jnp.ones((1,), bool)])
+    idx = jnp.where(last, cid_s, base.shape[0])          # drop non-last
+    merged = base.at[idx].set(full, mode="drop")
+    # unsort the per-row outputs back to submission order
+    inv = jnp.argsort(order)
+    new = new_s[inv]
+    return merged, new, jnp.any(new != 0, axis=-1)
+
+
+def _seg_prefix_or_flat(cid_s: jax.Array, bm_s: jax.Array) -> jax.Array:
+    """Single-level exclusive segmented prefix-OR (for batches too small
+    or oddly-shaped for the grouped path)."""
+    B, W = bm_s.shape
     same_prev = jnp.concatenate(
         [jnp.zeros((1,), bool), cid_s[1:] == cid_s[:-1]])
     pre = jnp.where(
@@ -143,18 +223,7 @@ def diff_merge(base: jax.Array, call_ids: jax.Array, bitmaps: jax.Array
             [jnp.zeros((min(s, B),), bool), cid_s[s:] == cid_s[:-s]])[:B]
         excl = jnp.where(same[:, None], jnp.bitwise_or(excl, shifted), excl)
         s *= 2
-
-    prev = jnp.bitwise_or(base[cid_s], excl)
-    new_s = jnp.bitwise_and(bm_s, jnp.bitwise_not(prev))
-    full = jnp.bitwise_or(prev, bm_s)
-    # one scatter per segment: the last row of each run holds base|seg-OR
-    last = jnp.concatenate([cid_s[1:] != cid_s[:-1], jnp.ones((1,), bool)])
-    idx = jnp.where(last, cid_s, base.shape[0])          # drop non-last
-    merged = base.at[idx].set(full, mode="drop")
-    # unsort the per-row outputs back to submission order
-    inv = jnp.argsort(order)
-    new = new_s[inv]
-    return merged, new, jnp.any(new != 0, axis=-1)
+    return excl
 
 
 def popcount_rows(mat: jax.Array) -> jax.Array:

@@ -1,0 +1,154 @@
+"""Coverage for the round-3 device hot-path machinery: the vectorized
+PcMap hash table, chunked map_rows, the uint16/int32 update_stream wire
+paths, grouped diff_merge, the submit/resolve pipeline, and the
+device-row → corpus-index mapping of the weighted sampler — each pinned
+against a sequential/numpy reference (SURVEY §4.1 strategy)."""
+
+import numpy as np
+import pytest
+
+from syzkaller_tpu.cover import sets
+from syzkaller_tpu.cover.engine import CoverageEngine, diff_merge, pack_pcs
+from syzkaller_tpu.fuzzer.device_signal import DeviceSignal
+from syzkaller_tpu.fuzzer.pcmap import PcMap
+
+
+def test_pcmap_map_flat_first_seen_and_overflow(rng):
+    pm = PcMap(1024 + 8, reserve_overflow=1024)
+    out = pm.map_flat(np.array([9, 5, 9, 7], np.uint64))
+    # first-seen order assigns sequential direct indices
+    assert list(out) == [0, 1, 0, 2]
+    assert pm.pc_of(1) == 5
+    # exhaust the direct region (8 slots), then overflow counts per lookup
+    pm.map_flat(np.arange(100, 200).astype(np.uint64))
+    hits0 = pm.overflow_hits
+    assert hits0 > 0
+    again = pm.map_flat(np.array([150, 150], np.uint64))
+    assert (again >= pm.direct_cap).all()
+    assert pm.overflow_hits == hits0 + 2      # counted per occurrence
+    # direct-mapped PCs stay stable and never count
+    assert pm.index_of(9) == 0
+
+
+def test_pcmap_matches_scalar_reference(rng):
+    """Vectorized batch mapping == one-at-a-time mapping on a fresh map."""
+    pcs = rng.integers(0, 5000, size=400).astype(np.uint64)
+    pm_vec = PcMap(1 << 12)
+    vec = pm_vec.map_flat(pcs)
+    pm_seq = PcMap(1 << 12)
+    seq = np.array([pm_seq.index_of(int(p)) for p in pcs])
+    assert (vec == seq).all()
+
+
+def test_map_rows_chunking_preserves_all_pcs(rng):
+    pm = PcMap(1 << 12)
+    K = 16
+    covers = [np.sort(rng.choice(3000, size=n, replace=False)).astype(np.uint64)
+              for n in (40, 3, 0, 17)]
+    idx, valid, owner = pm.map_rows(covers, K, chunk=True, pad_rows=4)
+    assert idx.shape[0] % 4 == 0
+    # every cover's PCs appear exactly once across its rows
+    for i, cov in enumerate(covers):
+        rows = np.nonzero(owner == i)[0]
+        assert len(rows) == max(1, -(-len(cov) // K))
+        got = np.sort(idx[rows][valid[rows]])
+        want = np.sort(pm.map_flat(cov))
+        assert (got == want).all()
+    # padding rows are unowned and invalid
+    pad = np.nonzero(owner == -1)[0]
+    assert not valid[pad].any()
+
+
+def test_update_stream_matches_per_batch(rng):
+    for npcs in (1 << 12, 1 << 17):   # uint16 wire and int32 wire
+        ncalls, S, B, K = 6, 5, 8, 16
+        call_ids = rng.integers(0, ncalls, size=(S, B)).astype(np.int32)
+        pc_idx = rng.integers(0, npcs, size=(S, B, K)).astype(np.int32)
+        # unique indices per row (engine contract)
+        for s in range(S):
+            for b in range(B):
+                pc_idx[s, b] = (np.arange(K) * 37 + int(rng.integers(npcs))) % npcs
+        valid = rng.random((S, B, K)) < 0.8
+        eng1 = CoverageEngine(npcs=npcs, ncalls=ncalls, corpus_cap=4,
+                              batch=B, max_pcs_per_exec=K)
+        ref = np.stack([eng1.update_batch(call_ids[s], pc_idx[s],
+                                          valid[s]).has_new
+                        for s in range(S)])
+        eng2 = CoverageEngine(npcs=npcs, ncalls=ncalls, corpus_cap=4,
+                              batch=B, max_pcs_per_exec=K)
+        got = np.asarray(eng2.update_stream(call_ids, pc_idx, valid))
+        assert (ref == got).all(), f"npcs={npcs}"
+        assert (np.asarray(eng1.max_cover) == np.asarray(eng2.max_cover)).all()
+
+
+@pytest.mark.parametrize("pattern", ["random", "single", "two", "runs"])
+def test_diff_merge_grouped_matches_flat(rng, pattern):
+    """The two-level grouped scan must be bit-exact vs the single-level
+    path on adversarial call-id layouts (runs spanning group borders,
+    impure boundary groups, one giant run)."""
+    import jax.numpy as jnp
+
+    npcs, B, K, C = 1 << 12, 64, 16, 8
+    if pattern == "random":
+        cid = rng.integers(0, C, B)
+    elif pattern == "single":
+        cid = np.zeros(B)
+    elif pattern == "two":
+        cid = (np.arange(B) >= 37).astype(int)
+    else:
+        cid = np.repeat(np.arange(8), 8)
+    cid = np.sort(cid).astype(np.int32)
+    rng.shuffle(cid)                      # unsorted input exercises argsort
+    pc = np.stack([(np.arange(K) * 13 + int(rng.integers(npcs))) % npcs
+                   for _ in range(B)]).astype(np.int32)
+    va = rng.random((B, K)) < 0.9
+    from syzkaller_tpu.cover.engine import nwords_for
+    bm = pack_pcs(jnp.asarray(pc), jnp.asarray(va), npcs, assume_unique=True)
+    base = jnp.asarray(rng.integers(0, 1 << 30,
+                                    (C, nwords_for(npcs))).astype(np.uint32))
+    m1, n1, h1 = diff_merge(base, jnp.asarray(cid), bm, group=16)
+    m2, n2, h2 = diff_merge(base, jnp.asarray(cid), bm, group=B + 1)  # flat
+    assert (np.asarray(m1) == np.asarray(m2)).all()
+    assert (np.asarray(n1) == np.asarray(n2)).all()
+    assert (np.asarray(h1) == np.asarray(h2)).all()
+
+
+def test_submit_resolve_pipeline(rng):
+    """Two in-flight batches resolve to the same verdicts as synchronous
+    check_batch on a twin engine, state sequenced in submission order."""
+    sig1 = DeviceSignal(ncalls=4, npcs=1 << 12, flush_batch=8, max_pcs=32)
+    sig2 = DeviceSignal(ncalls=4, npcs=1 << 12, flush_batch=8, max_pcs=32)
+    batches = []
+    for _ in range(3):
+        batches.append([
+            (int(rng.integers(4)),
+             rng.integers(0, 3000, size=20).astype(np.uint64))
+            for _ in range(5)])
+    tickets = [sig1.submit_batch(b) for b in batches]     # all in flight
+    got = [sig1.resolve(t) for t in tickets]
+    want = [sig2.check_batch(b) for b in batches]
+    for g, w in zip(got, want):
+        assert (g == w).all()
+
+
+def test_sample_corpus_indices_row_mapping(rng):
+    """Chunked covers fold to ONE device row per program; sampled rows
+    translate to the caller's corpus indices even when the matrix
+    fills while the host corpus keeps growing."""
+    sig = DeviceSignal(ncalls=4, npcs=1 << 12, flush_batch=4, max_pcs=8,
+                       corpus_cap=3)
+    # program 0: long cover (3 chunks of 8) -> still one row
+    sig.merge_corpus(1, np.arange(20).astype(np.uint64), corpus_index=100)
+    assert len(sig._row2corpus) == 1
+    sig.merge_corpus(2, np.arange(50, 60).astype(np.uint64), corpus_index=101)
+    sig.merge_corpus(3, np.arange(90, 95).astype(np.uint64), corpus_index=102)
+    # matrix now full: admission keeps merging cover but records no row
+    sig.merge_corpus(1, np.arange(200, 220).astype(np.uint64),
+                     corpus_index=103)
+    assert sig.stat_corpus_full == 1
+    assert sig._row2corpus == [100, 101, 102]
+    idx = sig.sample_corpus_indices(64)
+    assert len(idx) > 0
+    assert set(idx.tolist()) <= {100, 101, 102}
+    # the triage gate still rejects what the full matrix absorbed
+    assert len(sig.triage_new(1, np.arange(200, 220).astype(np.uint64))) == 0
