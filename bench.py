@@ -221,29 +221,45 @@ def bench_corpus_scale(rng, C=100_000):
     active = jnp.ones((C,), bool)
     fn = jax.jit(minimize_cover_scan)
     keep = fn(mat, active)
-    jax.block_until_ready(keep)         # compile
+    int(keep.sum())                     # compile + VALUE barrier
     t0 = time.perf_counter()
     keep = fn(mat, active)
-    jax.block_until_ready(keep)
-    min_dt = time.perf_counter() - t0
+    kept = int(keep.sum())              # block_until_ready lies on this
+    min_dt = time.perf_counter() - t0   # backend; fetch a value instead
 
-    # batched choice-table draws (the per-mutation decision stream)
+    # batched choice-table draws (the per-mutation decision stream):
+    # like the production fused step, many draw batches run per dispatch
+    # (lax.scan) with a value-fetch barrier — per-call dispatch overhead
+    # (~10ms on this tunnel) otherwise swamps the draw itself
     probs = jnp.full((NCALLS, NCALLS), 0.5, jnp.float32)
     enabled = jnp.ones((NCALLS,), bool)
     prev = jnp.asarray(rng.integers(0, NCALLS, 4096).astype(np.int32))
-    sfn = jax.jit(sample_calls)
-    out = sfn(key, probs, prev, enabled)
-    jax.block_until_ready(out)
+    SDRAW = 64
+
+    @jax.jit
+    def draw_many(key, prev):
+        def body(carry, _):
+            k, pv = carry
+            k, sub = jax.random.split(k)
+            nxt = sample_calls(sub, probs, pv, enabled)
+            return (k, nxt), nxt[0]
+        (k, pv), outs = jax.lax.scan(body, (key, prev), None, length=SDRAW)
+        return pv, outs.sum()
+
+    pv, out = draw_many(key, prev)
+    int(out)
     t0 = time.perf_counter()
     iters = 0
     while time.perf_counter() - t0 < 2.0:
-        out = sfn(jax.random.fold_in(key, iters), probs, prev, enabled)
+        pv, out = draw_many(jax.random.fold_in(key, iters), pv)
         iters += 1
-    jax.block_until_ready(out)
-    draw_rate = 4096 * iters / (time.perf_counter() - t0)
+        if iters % 8 == 0:
+            int(out)
+    int(out)
+    draw_rate = 4096 * SDRAW * iters / (time.perf_counter() - t0)
     return {
         "minimize_100k_rows_sec": round(min_dt, 3),
-        "minimize_100k_kept": int(np.asarray(keep).sum()),
+        "minimize_100k_kept": kept,
         "choice_draws_per_sec": round(draw_rate, 1),
     }
 

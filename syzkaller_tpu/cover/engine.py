@@ -280,12 +280,27 @@ def minimize_cover_scan(corpus: jax.Array, active: jax.Array) -> jax.Array:
 def sample_calls(key: jax.Array, probs: jax.Array, prev: jax.Array,
                  enabled: jax.Array) -> jax.Array:
     """Batched ChoiceTable draw: (B,) prev call ids (-1 = no context) →
-    (B,) next call ids ~ probs[prev] restricted to enabled calls."""
+    (B,) next call ids ~ probs[prev] restricted to enabled calls.
+
+    Prefix-CDF formulation — exactly the reference's Choose (one draw
+    into the prefix-sum row, prog/prio.go:230-249) vectorized: ONE
+    uniform per draw and a compare-and-sum instead of a Gumbel trick
+    that needs B×C random bits (RNG generation measures ~160M u32/s on
+    this backend, so the Gumbel path was RNG-bound)."""
+    C = probs.shape[0]
     rows = jnp.where(prev[:, None] >= 0,
-                     probs[jnp.clip(prev, 0, probs.shape[0] - 1)],
-                     jnp.ones((1, probs.shape[0]), probs.dtype))
-    logits = jnp.where(enabled[None, :], jnp.log(rows + 1e-9), -jnp.inf)
-    return jax.random.categorical(key, logits, axis=-1)
+                     probs[jnp.clip(prev, 0, C - 1)],
+                     jnp.ones((1, C), probs.dtype))
+    w = jnp.where(enabled[None, :], rows, 0.0)
+    cdf = jnp.cumsum(w, axis=1)
+    u = jax.random.uniform(key, (prev.shape[0],)) * cdf[:, -1]
+    # index of the first cdf entry > u; interior zero-weight (disabled)
+    # slots have flat cdf and can't be selected.  f32 rounding can push
+    # u up to exactly the row total (count == C), so clamp to the LAST
+    # nonzero-weight index — a bare C-1 clamp could emit a disabled id.
+    idx = jnp.sum((u[:, None] >= cdf).astype(jnp.int32), axis=1)
+    last_ok = C - 1 - jnp.argmax((w > 0)[:, ::-1], axis=1)
+    return jnp.minimum(idx, last_ok)
 
 
 def dynamic_prios(call_matrix: jax.Array) -> jax.Array:
