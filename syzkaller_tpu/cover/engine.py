@@ -38,6 +38,37 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 
+def pc_mesh(n_devices: int, platform: str = "") -> Mesh:
+    """1D device mesh over the PC (bitmap word) axis — the long-axis
+    sharding of SURVEY §5.  Production entry point for the config `mesh`
+    knob (BASELINE config #4): elementwise diff/merge stays chip-local,
+    verdict reductions ride ICI.
+
+    `platform` pins the device platform ("cpu" for virtual-device tests
+    and dryruns — avoids constructing an accelerator client at all);
+    empty means the default platform, with a LOUD fallback to virtual
+    CPU devices when it has too few — a silent fallback would quietly
+    turn the device-resident matrices into host-RAM arrays."""
+    from syzkaller_tpu.utils import log
+
+    devs = jax.devices(platform) if platform else jax.devices()
+    if len(devs) < n_devices and not platform:
+        try:
+            cpu = jax.devices("cpu")
+        except RuntimeError:
+            cpu = []
+        if len(cpu) >= n_devices:
+            log.logf(0, "WARNING: mesh=%d exceeds the %d default-platform "
+                     "device(s); falling back to %d virtual CPU devices — "
+                     "the coverage engine will run on host CPU",
+                     n_devices, len(devs), n_devices)
+            devs = cpu
+    if len(devs) < n_devices:
+        raise ValueError(
+            f"mesh wants {n_devices} devices, have {len(devs)}")
+    return Mesh(np.array(devs[:n_devices]), ("pc",))
+
+
 def nwords_for(npcs: int, align: int = 64) -> int:
     # 64-word alignment: pack_pcs factors words as (hi, 64-lo) for its
     # MXU one-hot matmuls

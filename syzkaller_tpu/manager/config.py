@@ -44,6 +44,11 @@ class Config:
     corpus_cap: int = 1 << 14
     flush_batch: int = 256
     fuzzer_device: bool = False        # fuzzers run signal diffs on device
+    mesh: int = 0                      # shard the PC axis over N devices
+    #                                    (0/1 = single-device engine;
+    #                                    BASELINE config #4's device mesh)
+    mesh_platform: str = ""            # pin mesh devices to a platform
+    #                                    ("cpu" = virtual-device mesh)
     # VM-type specific (qemu)
     kernel: str = ""
     image: str = ""
@@ -109,6 +114,11 @@ class Config:
             raise ConfigError("gce requires gce_image")
         if self.type in ("lkvm", "kvm") and not self.kernel:
             raise ConfigError("lkvm requires kernel")
+        if self.mesh < 0:
+            raise ConfigError(f"invalid mesh {self.mesh}")
+        # NOTE: device availability for `mesh` is checked when the
+        # manager builds the engine (cover.engine.pc_mesh raises) —
+        # config linting must not initialize an accelerator runtime.
 
     def enabled_calls(self, table: SyscallTable) -> list[str]:
         """Apply enable/disable globs (ref config.go:183-229)."""

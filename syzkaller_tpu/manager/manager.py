@@ -70,9 +70,16 @@ class Manager:
             files=None if cfg.descriptions in ("all", "linux")
             else [cfg.descriptions])
 
+        # the config `mesh` knob shards the engine's PC axis over N
+        # devices (BASELINE config #4: device-resident global coverage
+        # matrix with on-mesh merges); 0/1 keeps a single-device engine
+        mesh = None
+        if cfg.mesh >= 2:
+            from syzkaller_tpu.cover.engine import pc_mesh
+            mesh = pc_mesh(cfg.mesh, cfg.mesh_platform)
         self.engine = CoverageEngine(
             npcs=cfg.npcs, ncalls=self.table.count,
-            corpus_cap=cfg.corpus_cap, batch=cfg.flush_batch)
+            corpus_cap=cfg.corpus_cap, batch=cfg.flush_batch, mesh=mesh)
         self.static_prios = P.calculate_priorities(self.table)
         self.engine.set_priorities(self.static_prios)
         self.enabled_names = cfg.enabled_calls(self.table)
