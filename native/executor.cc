@@ -159,6 +159,19 @@ static void segv_handler(int sig, siginfo_t* info, void* ctx)
 {
 	if (segv_armed)
 		siglongjmp(segv_env, 1);
+	// async-signal-safe breadcrumb: which address an UNARMED fault hit
+	char buf[64];
+	int n = 0;
+	uint64_t addr = (uint64_t)info->si_addr;
+	const char hex[] = "0123456789abcdef";
+	const char pfx[] = "unarmed SEGV at 0x";
+	for (const char* p = pfx; *p; p++)
+		buf[n++] = *p;
+	for (int i = 60; i >= 0; i -= 4)
+		buf[n++] = hex[(addr >> i) & 15];
+	buf[n++] = '\n';
+	ssize_t w = write(2, buf, n);
+	(void)w;
 	_exit(kFailStatus);
 }
 

@@ -148,12 +148,16 @@ class PcMap:
         with self._mu:
             return self._map_flat_locked(np.asarray(pcs, np.uint64))
 
-    def preseed(self, pcs) -> None:
+    def preseed(self, pcs) -> int:
         """Pre-assign indices for a known PC universe (vmlinux scan):
-        restart-stable, and real-kernel PCs never overflow."""
+        restart-stable.  Returns how many of THESE pcs landed in the
+        hashed overflow region (computed from this call's own results —
+        the shared overflow_hits counter also moves under concurrent
+        RPC-path lookups, so a before/after delta would lie)."""
         if not isinstance(pcs, np.ndarray):
             pcs = np.array(list(pcs), np.uint64)   # C-speed conversion
-        self.map_flat(pcs)
+        out = self.map_flat(pcs)
+        return int((out >= self.direct_cap).sum())
 
     def index_of(self, pc: int) -> int:
         return int(self.map_flat(np.array([pc], np.uint64))[0])

@@ -64,6 +64,9 @@ class ExecResult:
     failed: bool = False    # executor reported failure
     hanged: bool = False    # worker killed on timeout
     restarted: bool = False # env was relaunched
+    status: int = 0         # raw worker status byte (positive: 67/68/69)
+    #                         or, when the executor process itself died,
+    #                         a negative code (-exitcode or -signum)
 
     def per_call(self, ncalls: int) -> "list[CallResult | None]":
         out: "list[CallResult | None]" = [None] * ncalls
@@ -210,9 +213,15 @@ class Env:
             if code == STATUS_FAIL:
                 raise ExecutorFailure("executor failed (status 67)")
             res.restarted = True
+            # process-death domain is strictly NEGATIVE: exit(N) -> -N,
+            # signal death (wait() = -signum) stays negative, and a
+            # clean exit-0 before replying gets the sentinel -256 —
+            # never collides with positive worker-reply status bytes
+            res.status = -code if code > 0 else (code if code < 0 else -256)
             self._parse_output(res)
             return res
         status = reply[0]
+        res.status = status
         if status == STATUS_FAIL:
             res.failed = True
         elif status == STATUS_ERROR:

@@ -116,7 +116,8 @@ def crash(mgr, title: str) -> str:
             f"logs under workdir/crashes/</p>")
 
 
-_cover_cache: dict = {}
+_cover_latest: dict = {}      # id(mgr) -> (covered-set key, report html)
+_cover_busy: dict = {}        # id(mgr) -> regeneration in flight
 _cover_cache_mu = threading.Lock()
 
 
@@ -152,19 +153,45 @@ def cover(mgr, call: str) -> str:
         idx = mgr.engine.covered_indices()
         pcs32 = mgr.pcmap.pcs_of(idx)
         if len(pcs32):
-            key = (id(mgr), len(pcs32))
-            # serialize regeneration: concurrent /cover hits must not
-            # each run the minutes-long symbolization pass
+            # Stale-while-revalidate: always serve the most recent
+            # COMPLETED report (coverage moves faster than the
+            # minutes-long symbolization, so exact-key caching would
+            # never converge); at most ONE background regeneration runs
+            # at a time, keyed on the covered SET (not its size — the
+            # set can change without changing the count).  Failures are
+            # logged, never cached, so the next request retries.
+            import hashlib
+            key = hashlib.sha1(np.sort(pcs32).tobytes()).hexdigest()
+            start = False
             with _cover_cache_mu:
-                report = _cover_cache.get(key)
-                if report is None:
-                    base = vm_offset(mgr.cfg.vmlinux)
-                    covered = [restore_pc(int(p), base) for p in pcs32]
-                    report = generate_cover_html(mgr.cfg.vmlinux, covered,
-                                                 scan.pcs)
-                    _cover_cache.clear()       # one report per manager
-                    _cover_cache[key] = report
-            body += report
+                latest_key, report = _cover_latest.get(id(mgr), (None, None))
+                if key != latest_key and not _cover_busy.get(id(mgr)):
+                    _cover_busy[id(mgr)] = True
+                    start = True
+            if start:
+                def _generate(key=key, pcs32=pcs32):
+                    try:
+                        base = vm_offset(mgr.cfg.vmlinux)
+                        covered = [restore_pc(int(p), base) for p in pcs32]
+                        rep = generate_cover_html(mgr.cfg.vmlinux, covered,
+                                                  scan.pcs)
+                        with _cover_cache_mu:
+                            _cover_latest[id(mgr)] = (key, rep)
+                    except Exception as e:
+                        log.logf(0, "cover line report failed: %s", e)
+                    finally:
+                        with _cover_cache_mu:
+                            _cover_busy[id(mgr)] = False
+                threading.Thread(target=_generate, daemon=True).start()
+            if report is None:
+                body += ("<p><i>line report is being generated — "
+                         "reload in a moment</i></p>")
+            else:
+                if key != latest_key:
+                    body += ("<p><i>line report below is from an earlier "
+                             "coverage snapshot; a refresh is running"
+                             "</i></p>")
+                body += report
     return body
 
 

@@ -97,7 +97,22 @@ class CoverScanner:
             self.pcs = scan_cover_pcs(self.binary)
             if self._pcmap is not None and self.pcs:
                 # executor reports 32-bit truncated PCs — seed with those
-                self._pcmap.preseed(pc & 0xFFFFFFFF for pc in self.pcs)
+                seed = sorted({pc & 0xFFFFFFFF for pc in self.pcs})
+                spilled = self._pcmap.preseed(seed)
+                if spilled:
+                    # the universe exceeds direct capacity: the tail
+                    # aliases into the tiny hashed overflow region —
+                    # loud warning with a concretely sufficient size
+                    # (direct entries now held + the spill + overflow)
+                    need = (len(self._pcmap) + spilled
+                            + self._pcmap.overflow)
+                    log.logf(0, "WARNING: %d of %d scanned PCs spilled "
+                             "into the %d-slot hashed overflow region — "
+                             "coverage for them will alias.  Raise the "
+                             "`npcs` config to the next power of two "
+                             ">= %d for full direct mapping",
+                             spilled, len(seed), self._pcmap.overflow,
+                             need)
             log.logf(0, "cover scan: %d coverable PCs in %s",
                      len(self.pcs), self.binary)
         except (OSError, subprocess.SubprocessError) as e:

@@ -37,6 +37,12 @@ class Oops:
 
 OOPSES: list[Oops] = [
     Oops(b"BUG:", [
+        # "double-free or invalid-free" spells its class with spaces, so
+        # it must precede the single-token class formats; the ambiguity
+        # is the kernel's — keep the full title so the two bug classes
+        # don't dedup into one bucket
+        OopsFormat(_compile(r"BUG: KASAN: double-free or invalid-free in ([a-zA-Z0-9_]+)"),
+                   "KASAN: double-free or invalid-free in {0}"),
         OopsFormat(_compile(r"BUG: KASAN: ([a-z\-]+) in {{FUNC}}(?:.*\n)+?.*(Read|Write) of size ([0-9]+)"),
                    "KASAN: {0} {2} in {1}"),
         OopsFormat(_compile(r"BUG: KASAN: ([a-z\-]+) on address(?:.*\n)+?.*(Read|Write) of size ([0-9]+)"),
