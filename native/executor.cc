@@ -1173,12 +1173,23 @@ static int run_worker(Prog* p)
 		if (mkdir(tmpdir, 0777) == 0)
 			if (chdir(tmpdir))
 				debug("chdir failed\n");
-		// map the data window (programs overlay their own mmaps)
+		// Map the data window (programs overlay their own mmaps).
+		// MAP_FIXED_NOREPLACE: plain MAP_FIXED would silently clobber
+		// whatever ASLR occasionally placed at kDataOffset; since the
+		// executor's layout is fixed at exec time, that poisons EVERY
+		// forked worker with the same unarmed SEGV (persistent
+		// status-67 streaks).  A retryable exit relaunches the
+		// executor and rerolls the layout instead.
+#ifndef MAP_FIXED_NOREPLACE
+#define MAP_FIXED_NOREPLACE 0x100000
+#endif
 		void* want = (void*)kDataOffset;
 		void* got = mmap(want, kDataSize, PROT_READ | PROT_WRITE,
-				 MAP_PRIVATE | MAP_ANONYMOUS | MAP_FIXED, -1, 0);
+				 MAP_PRIVATE | MAP_ANONYMOUS |
+				     MAP_FIXED_NOREPLACE, -1, 0);
 		if (got != want)
-			exitf("data window mmap failed");
+			exitf("data window mmap failed (collision at %p)",
+			      want);
 		if (flag_sandbox_setuid)
 			sandbox_setuid();
 		else if (flag_sandbox_namespace)
