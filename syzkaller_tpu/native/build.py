@@ -26,17 +26,24 @@ def _source_path(name: str) -> str:
     return os.path.join(NATIVE_DIR, name)
 
 
-def build_executor(force: bool = False) -> str:
-    """Compile native/executor.cc; returns the cached binary path."""
+def build_executor(force: bool = False, cxx: "str | None" = None) -> str:
+    """Compile native/executor.cc; returns the cached binary path.
+
+    Cross builds (the reference builds the executor per target arch,
+    Makefile:21-22): set SYZ_CXX or pass cxx, e.g.
+    `aarch64-linux-gnu-g++` — the KVM guest-setup path degrades to
+    ENOSYS off x86-64 (#if defined(__x86_64__) guard), everything else
+    is portable C++."""
     src = _source_path("executor.cc")
+    cxx = cxx or os.environ.get("SYZ_CXX", "g++")
     with open(src, "rb") as f:
-        digest = hashlib.sha1(f.read()).hexdigest()[:16]
+        digest = hashlib.sha1(f.read() + cxx.encode()).hexdigest()[:16]
     os.makedirs(_CACHE_DIR, exist_ok=True)
     out = os.path.join(_CACHE_DIR, f"syz-executor-{digest}")
     if os.path.exists(out) and not force:
         return out
     tmp = out + ".tmp"
-    base = ["g++", "-O2", "-pthread", "-Wall", "-Wno-unused-parameter",
+    base = [cxx, "-O2", "-pthread", "-Wall", "-Wno-unused-parameter",
             src, "-o", tmp]
     attempts = [base + ["-static"], base]
     last = None

@@ -87,15 +87,103 @@ def make_prog(includes: list[str], defines: list[tuple[str, str]],
 
 _UNDECLARED = re.compile(r"[‘']([A-Za-z_]\w*)[’'] undeclared")
 
+# arm64 (aarch64) speaks the asm-generic kernel ABI.  Without a cross
+# compiler on the build host, its consts are DERIVED: start from the
+# host (amd64) extraction for arch-independent userspace constants,
+# drop every __NR_* (the amd64 table does not apply), then overlay
+# everything the generic ABI defines — syscall numbers from
+# asm-generic/unistd.h and the generic file/tty/mman/socket constant
+# set — via a second probe compiled against ONLY those uapi headers.
+# The reference gets per-arch consts by extracting against a kernel
+# checkout per arch (extract.sh); asm-generic/unistd.h IS arm64's
+# table, so the derivation is exact for everything it covers.
+# Verify on real arm64 hardware with: python -m syzkaller_tpu.tools.extract -arch arm64-native
+GENERIC_ABI_HEADERS = [
+    "asm-generic/fcntl.h",
+    "asm-generic/ioctls.h",
+    "asm-generic/mman.h",       # pulls mman-common.h
+    "asm-generic/socket.h",
+]
 
-def extract(files: list[str], arch: str = "amd64", cc: str = "gcc",
-            out_path: str | None = None) -> dict[str, int]:
-    desc = parser.Description()
-    for p in files:
-        desc.merge(parser.parse_file(p))
-    consts, nrs = collect_names(desc)
-    includes = BASE_INCLUDES + [i for i in desc.includes if i not in BASE_INCLUDES]
+# __ARCH_WANT_* toggles arm64 sets in arch/arm64/include/(uapi/)asm/unistd.h
+ARM64_WANTS = [
+    "__ARCH_WANT_RENAMEAT",
+    "__ARCH_WANT_NEW_STAT",
+    "__ARCH_WANT_SET_GET_RLIMIT",
+    "__ARCH_WANT_SYS_CLONE3",
+    "__ARCH_WANT_MEMFD_SECRET",
+]
 
+# arch/arm64/include/uapi/asm/fcntl.h OVERRIDES the asm-generic fcntl
+# defaults (the arm legacy layout) — the generic header alone gets these
+# four swapped around, which would silently break every O_DIRECTORY/
+# O_DIRECT open the fuzzer generates on the target.
+ARM64_FCNTL = {
+    "O_DIRECTORY": 0o40000,
+    "O_NOFOLLOW": 0o100000,
+    "O_DIRECT": 0o200000,
+    "O_LARGEFILE": 0o400000,
+    "O_TMPFILE": 0o20000000 | 0o40000,
+}
+
+# amd64-only constants that must NOT leak into the arm64 table (their
+# flags simply lose that value, matching the arch reality)
+ARM64_ABSENT = {
+    "MAP_32BIT",
+    "ARCH_SET_FS", "ARCH_SET_GS", "ARCH_GET_FS", "ARCH_GET_GS",
+    "ARCH_GET_CPUID", "ARCH_SET_CPUID",
+}
+
+
+def make_generic_probe(names: list[str], nrs: list[str]) -> str:
+    lines = ["#include <stdio.h>"]
+    for w in ARM64_WANTS:
+        lines.append(f"#define {w} 1")
+    for inc in GENERIC_ABI_HEADERS:
+        lines.append(f"#include <{inc}>")
+    lines.append("#include <asm-generic/unistd.h>")
+    lines.append("int main(void) {")
+    for c in names:
+        lines.append(f"#ifdef {c}")
+        lines.append(f'    printf("{c} = %llu\\n", (unsigned long long)({c}));')
+        lines.append("#endif")
+    for nr in nrs:
+        lines.append(f"#ifdef __NR_{nr}")
+        lines.append(f'    printf("__NR_{nr} = %llu\\n", '
+                     f'(unsigned long long)(__NR_{nr}));')
+        lines.append("#endif")
+    lines.append("    return 0;\n}")
+    return "\n".join(lines)
+
+
+def extract_generic_abi(consts: "set[str]", nrs: "set[str]",
+                        cc: str = "gcc") -> dict[str, int]:
+    """Values the asm-generic ABI defines, for the requested names."""
+    values: dict[str, int] = {}
+    with tempfile.TemporaryDirectory() as td:
+        src = os.path.join(td, "generic.c")
+        binp = os.path.join(td, "generic")
+        with open(src, "w") as f:
+            f.write(make_generic_probe(sorted(consts), sorted(nrs)))
+        r = subprocess.run([cc, "-w", "-O0", src, "-o", binp],
+                           capture_output=True, text=True)
+        if r.returncode != 0:
+            sys.stderr.write(r.stderr)
+            raise RuntimeError("generic-ABI probe failed to compile")
+        out = subprocess.run([binp], capture_output=True, text=True,
+                             check=True)
+        for line in out.stdout.splitlines():
+            name, _, val = line.partition(" = ")
+            values[name.strip()] = int(val)
+    return values
+
+
+def _resolve_host(desc: parser.Description, consts: "set[str]",
+                  nrs: "set[str]", cc: str) -> dict[str, int]:
+    """Resolve names against the build host's headers (iteratively
+    dropping undeclared ones by parsing compiler diagnostics)."""
+    includes = BASE_INCLUDES + [i for i in desc.includes
+                                if i not in BASE_INCLUDES]
     unresolved: set[str] = set()
     values: dict[str, int] = {}
     remaining = sorted(consts)
@@ -104,7 +192,8 @@ def extract(files: list[str], arch: str = "amd64", cc: str = "gcc",
         binp = os.path.join(td, "extract")
         for _ in range(10):
             with open(src, "w") as f:
-                f.write(make_prog(includes, desc.defines, remaining, sorted(nrs)))
+                f.write(make_prog(includes, desc.defines, remaining,
+                                  sorted(nrs)))
             r = subprocess.run([cc, "-w", "-O0", src, "-o", binp],
                                capture_output=True, text=True)
             if r.returncode == 0:
@@ -112,31 +201,71 @@ def extract(files: list[str], arch: str = "amd64", cc: str = "gcc",
             bad = set(_UNDECLARED.findall(r.stderr))
             if not bad:
                 sys.stderr.write(r.stderr)
-                raise RuntimeError("const extraction failed with unparseable errors")
+                raise RuntimeError(
+                    "const extraction failed with unparseable errors")
             unresolved |= bad
             remaining = [c for c in remaining if c not in bad]
         else:
             raise RuntimeError("const extraction did not converge")
-        out = subprocess.run([binp], capture_output=True, text=True, check=True)
+        out = subprocess.run([binp], capture_output=True, text=True,
+                             check=True)
         for line in out.stdout.splitlines():
             name, _, val = line.partition(" = ")
             values[name.strip()] = int(val)
     for name, val in OVERRIDES.items():
         if name in values:
             values[name] = val
-
     if unresolved:
-        print(f"unresolved ({len(unresolved)}): {', '.join(sorted(unresolved))}",
-              file=sys.stderr)
+        print(f"unresolved ({len(unresolved)}): "
+              f"{', '.join(sorted(unresolved))}", file=sys.stderr)
+    return values
+
+
+def _write_consts(values: dict[str, int], arch: str,
+                  out_path: "str | None", header: str) -> None:
     if out_path is None:
         out_path = os.path.join(DESC_DIR, "consts", f"{arch}.const")
     os.makedirs(os.path.dirname(out_path), exist_ok=True)
     with open(out_path, "w") as f:
-        f.write("# Generated by syzkaller_tpu.tools.extract; do not edit.\n")
+        f.write(header)
         for name in sorted(values):
             f.write(f"{name} = {values[name]}\n")
     print(f"wrote {len(values)} consts to {out_path}")
-    return values
+
+
+def extract(files: list[str], arch: str = "amd64", cc: str = "gcc",
+            out_path: str | None = None) -> dict[str, int]:
+    desc = parser.Description()
+    for p in files:
+        desc.merge(parser.parse_file(p))
+    consts, nrs = collect_names(desc)
+    host = _resolve_host(desc, consts, nrs, cc)
+    if arch == "arm64":
+        # host extraction for arch-independent values + generic-ABI
+        # overlay (see GENERIC_ABI_HEADERS note) + arm64's own fcntl
+        # override set, minus the amd64-only names
+        over = extract_generic_abi(consts, nrs, cc=cc)
+        values = {k: v for k, v in host.items()
+                  if not k.startswith("__NR_") and k not in ARM64_ABSENT}
+        values.update(over)
+        for name, val in ARM64_FCNTL.items():
+            if name in values:
+                values[name] = val
+        _write_consts(
+            values, arch, out_path,
+            "# Generated by syzkaller_tpu.tools.extract -arch arm64; "
+            "do not edit.\n"
+            "# Derived on an x86-64 host: arch-independent values from "
+            "the host extraction,\n"
+            "# syscall NRs and tty/mman/socket constants overlaid from "
+            "the asm-generic uapi\n"
+            "# headers, fcntl flags from arm64's own uapi override set "
+            "(ARM64_FCNTL).\n")
+        return values
+    _write_consts(host, arch, out_path,
+                  "# Generated by syzkaller_tpu.tools.extract; "
+                  "do not edit.\n")
+    return host
 
 
 def main() -> None:

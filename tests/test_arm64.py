@@ -1,0 +1,89 @@
+"""arm64 architecture support: the derived asm-generic const table
+compiles into a working syscall table (ref sysgen builds sys_arm64.go
+from sys/*_arm64.const, sysgen/syscallnr.go:19-23), exec serialization
+emits arm64 syscall numbers, and generation/mutation run against the
+arm64 table."""
+
+import numpy as np
+import pytest
+
+import syzkaller_tpu.prog as P
+from syzkaller_tpu.prog import encodingexec
+from syzkaller_tpu.sys.table import load_table
+
+
+@pytest.fixture(scope="module")
+def arm64():
+    return load_table(arch="arm64")
+
+
+@pytest.fixture(scope="module")
+def amd64():
+    return load_table(arch="amd64")
+
+
+def test_arm64_table_loads(arm64, amd64):
+    assert arm64.count > 800
+    # the generic ABI drops legacy entry points and keeps the *at forms
+    for legacy in ("open", "creat", "unlink", "mkdir", "rename",
+                   "epoll_create", "eventfd", "inotify_init"):
+        assert legacy not in arm64.call_map, legacy
+    for modern in ("openat", "unlinkat", "mkdirat", "renameat",
+                   "epoll_create1", "eventfd2", "inotify_init1"):
+        assert modern in arm64.call_map, modern
+    # arch-specific calls differ; shared ones resolve to different NRs
+    assert "arch_prctl" not in arm64.call_map
+    assert arm64.call_map["mmap"].nr == 222
+    assert arm64.call_map["openat"].nr == 56
+    assert arm64.call_map["read"].nr == 63
+    assert arm64.call_map["close"].nr == 57
+    assert amd64.call_map["mmap"].nr == 9       # and they are per-arch
+
+
+def test_arm64_resource_closure(arm64):
+    """fd resources stay constructible without legacy open (ref
+    TransitivelyEnabledCalls, sys/decl.go:444-485)."""
+    enabled = {s.name for s in arm64.transitively_enabled_calls()}
+    assert "openat" in enabled
+    assert "read" in enabled and "write" in enabled
+
+
+def test_arm64_exec_serialize_golden(arm64):
+    p = P.deserialize(b'r0 = openat(0xffffffffffffff9c, '
+                      b'"2e2f66696c653100", 0x0, 0x0)\n'
+                      b'mmap(&(0x20000000/0x1000)=nil, (0x1000), 0x3, '
+                      b'0x32, 0xffffffffffffffff, 0x0)\n'
+                      b'read(r0, &(0x20000000)="00", 0x1)\n', arm64)
+    words = list(np.frombuffer(P.serialize_for_exec(p), dtype="<u8"))
+    # the two call instructions carry the arm64 numbers
+    assert words.count(56) >= 1          # openat
+    icall = words.index(56)
+    assert words[icall + 1] == 0         # result index 0 (r0)
+    assert 63 in words[icall:]           # read
+    assert words[-1] == encodingexec.INSTR_EOF
+
+
+def test_arm64_generation_and_mutation(arm64):
+    r = P.Rand(np.random.default_rng(7))
+    for i in range(25):
+        p = P.generate(r, arm64, ncalls=8)
+        P.validate(p)
+        for c in p.calls:
+            assert c.meta.name in arm64.call_map
+        q = P.clone_prog(p)
+        P.mutate(q, r, arm64)
+        P.validate(q)
+        # roundtrip under the arm64 table
+        assert P.serialize(P.deserialize(P.serialize(p), arm64)) \
+            == P.serialize(p)
+
+
+def test_arm64_const_divergence(arm64, amd64):
+    """Shared call names resolve to different NRs; shared flag values
+    that the generic ABI redefines really differ in the tables."""
+    shared = set(arm64.call_map) & set(amd64.call_map)
+    assert len(shared) > 700
+    diff = [n for n in shared
+            if arm64.call_map[n].nr != amd64.call_map[n].nr]
+    # the two NR spaces are unrelated: almost everything moves
+    assert len(diff) > len(shared) * 9 // 10
