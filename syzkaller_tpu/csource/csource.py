@@ -437,6 +437,13 @@ static long syz_pseudo(uint64_t nr, uint64_t a0, uint64_t a1, uint64_t a2,
 \t\tuint64_t mode = a5 & 3, tp = 0, tl = 0;
 \t\tif (a4) { NONFAILING(mode = ((uint64_t*)a3)[0] & 3;
 \t\t\ttp = ((uint64_t*)a3)[1]; tl = ((uint64_t*)a3)[2]); }
+\t\tuint64_t oc0 = 0, oc4 = 0, oef = 0, ofl = 0;
+\t\tfor (uint64_t i = 0; i < a7 && i < 8; i++) {
+\t\t\tuint64_t ot = 0, ov = 0;
+\t\t\tNONFAILING(ot = ((uint64_t*)a6)[2*i]; ov = ((uint64_t*)a6)[2*i+1]);
+\t\t\tif (ot == 1) oc0 |= ov; else if (ot == 2) oc4 |= ov;
+\t\t\telse if (ot == 3) oef |= ov; else if (ot == 4) ofl |= ov;
+\t\t}
 \t\tif (tl > 16 * 4096) tl = 16 * 4096;
 \t\tNONFAILING(memcpy(mem + 0x8000, (void*)tp, tl));
 \t\tuint64_t* gdt = (uint64_t*)(mem + 0x4000);
@@ -473,10 +480,21 @@ static long syz_pseudo(uint64_t nr, uint64_t a0, uint64_t a1, uint64_t a2,
 \t\t\tsr.cs.limit = sr.ds.limit = 0xfffff; break;
 \t\t}
 \t\tsr.es = sr.ss = sr.fs = sr.gs = sr.ds;
+\t\tsr.cr0 |= oc0; sr.cr4 |= oc4; sr.efer |= oef;
 \t\tif (ioctl(a1, KVM_SET_SREGS, &sr)) return -1;
 \t\tstruct kvm_regs rg;
 \t\tmemset(&rg, 0, sizeof(rg));
-\t\trg.rip = 0x8000; rg.rsp = 0x7000; rg.rflags = 2;
+\t\trg.rip = 0x8000; rg.rsp = 0x7000; rg.rflags = 2 | ofl;
+#if defined(KVM_VCPUEVENT_VALID_SMM)
+\t\tif (a5 & 8) {
+\t\t\tstruct kvm_vcpu_events ev;
+\t\t\tmemset(&ev, 0, sizeof(ev));
+\t\t\tif (ioctl(a1, KVM_GET_VCPU_EVENTS, &ev) == 0) {
+\t\t\t\tev.flags |= KVM_VCPUEVENT_VALID_SMM; ev.smi.smm = 1;
+\t\t\t\tioctl(a1, KVM_SET_VCPU_EVENTS, &ev);
+\t\t\t}
+\t\t}
+#endif
 \t\treturn ioctl(a1, KVM_SET_REGS, &rg);
 \t}
 #endif
