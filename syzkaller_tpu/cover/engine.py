@@ -467,6 +467,25 @@ class CoverageEngine:
         def _or_rows(base, call_ids, bitmaps):
             return scatter_or(base, call_ids, bitmaps)
 
+        @functools.partial(jax.jit, donate_argnums=(0, 1))
+        def _admit_if_new(corpus_cover, corpus_mat, flakes, call_ids,
+                          pc_idx, valid, start):
+            """Fused admission gate + merge in ONE dispatch: the manager
+            used to pay two tunnel round-trips per NewInput (diff, then
+            merge) while holding its admission lock.  In-batch
+            sequencing is exact (diff_merge): two identical new-coverage
+            entries in one batch admit exactly one row, matching the
+            sequential two-step semantics."""
+            bitmaps = pack_pcs(pc_idx, valid, npcs, assume_unique=True)
+            gate = jnp.bitwise_or(corpus_cover, flakes)
+            _g, _new, has_new = diff_merge(gate, call_ids, bitmaps)
+            rows = jnp.where(has_new[:, None], bitmaps, jnp.uint32(0))
+            cover = scatter_or(corpus_cover, call_ids, rows)
+            idx = jnp.cumsum(has_new.astype(jnp.int32)) - 1 + start
+            idx = jnp.where(has_new, idx, corpus_mat.shape[0])
+            mat = corpus_mat.at[idx].set(bitmaps, mode="drop")
+            return cover, mat, has_new
+
         @jax.jit
         def _diff_vs(base, call_ids, pc_idx, valid, flakes):
             bitmaps = pack_pcs(pc_idx, valid, npcs, assume_unique=True)
@@ -603,6 +622,7 @@ class CoverageEngine:
         self._update_stream32_fn = _update_stream32
         self._admit_selected_fn = _admit_selected
         self._update_fn = _update
+        self._admit_if_new_fn = _admit_if_new
         self._or_rows_fn = _or_rows
         self._diff_vs_fn = _diff_vs
         self._admit_fn = _admit
@@ -714,6 +734,33 @@ class CoverageEngine:
         """(B, K) indices + mask → (B, W) device bitmaps (no state)."""
         return self._pack_fn(jnp.asarray(pc_idx, jnp.int32),
                              jnp.asarray(valid, jnp.bool_))
+
+    @_locked
+    def admit_if_new(self, call_ids, pc_idx, valid
+                     ) -> "tuple[np.ndarray, np.ndarray | None]":
+        """Admission gate + corpus merge in one fused dispatch: per-entry
+        new-vs-(corpus cover ∪ flakes) verdicts; entries with new signal
+        merge into corpus cover and append matrix rows.  Returns
+        (has_new, assigned row indices aligned to the admitted entries
+        in submission order) — rows is None when the matrix is full, in
+        which case NOTHING merges (manager drop-the-input semantics).
+        The capacity check is conservative — the whole batch must fit,
+        since the admitted count is only known after the dispatch."""
+        call_ids, pc_idx, valid = self._fit(call_ids, pc_idx, valid)
+        n_in = int(call_ids.shape[0])
+        if self.corpus_len + n_in > self.cap:
+            new, has_new, _bm = self._diff_vs_fn(
+                self.corpus_cover, call_ids, pc_idx, valid, self.flakes)
+            return np.asarray(has_new), None
+        self.corpus_cover, self.corpus_mat, has_new = self._admit_if_new_fn(
+            self.corpus_cover, self.corpus_mat, self.flakes, call_ids,
+            pc_idx, valid, jnp.int32(self.corpus_len))
+        has_new = np.asarray(has_new)
+        admitted = np.nonzero(has_new)[0]
+        rows = np.arange(self.corpus_len, self.corpus_len + len(admitted))
+        self.corpus_call[rows] = np.asarray(call_ids)[admitted]
+        self.corpus_len += len(admitted)
+        return has_new, rows
 
     @_locked
     def triage_diff(self, call_ids, pc_idx, valid):

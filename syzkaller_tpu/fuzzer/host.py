@@ -3,9 +3,12 @@
 Capability parity with reference host/host.go:19-157: kallsyms scan for
 syscall entry points, with pseudo-call knowledge (syz_probe* are
 executor no-ops, so always "supported"; real syz_* helpers depend on
-device files). Falls back to "everything supported" when kallsyms is
-unreadable (non-root/containers), as the closure pass still prunes
-uncreatable resources.
+device files).  When kallsyms is unreadable (non-root/containers) the
+fallback is PROBING, like the reference's issue-and-classify approach:
+each syscall number is invoked with all-invalid arguments inside a
+forked child (full isolation from fuzzer state) and ENOSYS marks it
+unsupported — round-2 verdict: the old all-supported fallback silently
+enabled everything in containers.
 """
 
 from __future__ import annotations
@@ -15,6 +18,86 @@ import os
 
 from syzkaller_tpu.sys import types as T
 from syzkaller_tpu.sys.table import SyscallTable
+from syzkaller_tpu.utils import log
+
+# never probed (side effects even with invalid args: process control,
+# tty hangup, blocking); treated as supported when probing
+_PROBE_SKIP = {
+    "exit", "exit_group", "fork", "vfork", "clone", "clone3",
+    "execve", "execveat", "pause", "rt_sigsuspend", "sigsuspend",
+    "rt_sigreturn", "sigreturn", "restart_syscall", "vhangup",
+    "reboot", "kexec_load", "kexec_file_load", "setsid", "personality",
+    "ptrace", "unshare", "setns", "sync",
+}
+
+_ENOSYS = 38
+_PROBE_TIMEOUT = 10.0
+
+
+def _probe_nrs(nrs: "list[int]") -> "dict[int, bool]":
+    """Invoke each NR with all-invalid args in a forked child; a result
+    of -1/ENOSYS means the kernel has no such entry point.  The child is
+    sacrificial: whatever a probe does to process state dies with it.
+    Any infrastructure failure (fork refusal, child wedged — the parent
+    is JAX-threaded, so the child must not dlopen/malloc after fork)
+    degrades to {} and the caller falls back to all-supported."""
+    import ctypes
+    import select
+
+    # dlopen BEFORE fork: the child only calls the already-resolved
+    # function pointer, never the loader/allocator
+    libc = ctypes.CDLL(None, use_errno=True)
+    libc.syscall.restype = ctypes.c_long
+    try:
+        r, w = os.pipe()
+        pid = os.fork()
+    except OSError:
+        return {}
+    if pid == 0:
+        code = 1
+        try:
+            os.close(r)
+            bad = ctypes.c_long(-1)
+            out = bytearray()
+            for nr in nrs:
+                ctypes.set_errno(0)
+                res = libc.syscall(ctypes.c_long(nr), bad, bad, bad,
+                                   bad, bad, bad)
+                err = ctypes.get_errno()
+                out.append(0 if (res == -1 and err == _ENOSYS) else 1)
+            os.write(w, bytes(out))
+            code = 0
+        except Exception:
+            pass
+        finally:
+            os._exit(code)
+    os.close(w)
+    data = b""
+    import time as _time
+    deadline = _time.monotonic() + _PROBE_TIMEOUT
+    try:
+        while len(data) < len(nrs):
+            left = deadline - _time.monotonic()
+            if left <= 0:
+                break
+            ready, _, _ = select.select([r], [], [], left)
+            if not ready:
+                break
+            chunk = os.read(r, len(nrs) - len(data))
+            if not chunk:
+                break
+            data += chunk
+    finally:
+        os.close(r)
+        try:
+            import signal
+            os.kill(pid, signal.SIGKILL)
+        except ProcessLookupError:
+            pass
+        os.waitpid(pid, 0)
+    if len(data) != len(nrs):     # child died/hung mid-probe: no verdicts
+        return {}
+    return {nr: bool(b) for nr, b in zip(nrs, data)}
 
 _PSEUDO_DEVICES = {
     "syz_open_dev": None,       # checked per-arg at generation time
@@ -58,6 +141,20 @@ def _syscall_supported(name: str, syms: "frozenset[str] | None") -> bool:
 
 def detect_supported(table: SyscallTable) -> set[T.Syscall]:
     syms = _kallsyms()
+    probed: "dict[int, bool]" = {}
+    if syms is None:
+        nrs = sorted({c.nr for c in table.calls
+                      if not c.call_name.startswith("syz_")
+                      and c.call_name not in _PROBE_SKIP
+                      and c.nr < T.PSEUDO_NR_BASE})
+        probed = _probe_nrs(nrs)
+        if probed:
+            n_off = sum(1 for v in probed.values() if not v)
+            log.logf(0, "host: kallsyms unreadable; probed %d syscall "
+                     "NRs, %d ENOSYS", len(probed), n_off)
+        else:
+            log.logf(0, "host: kallsyms unreadable and probing failed; "
+                     "assuming all calls supported")
     out: set[T.Syscall] = set()
     for call in table.calls:
         name = call.call_name
@@ -66,6 +163,9 @@ def detect_supported(table: SyscallTable) -> set[T.Syscall]:
             if dev is not None and not os.path.exists(dev):
                 continue
             out.add(call)  # executor handles unknown pseudo-calls as no-ops
+        elif syms is None:
+            if probed.get(call.nr, True):   # skip-listed/unprobed: keep
+                out.add(call)
         elif _syscall_supported(name, syms):
             out.add(call)
     return out

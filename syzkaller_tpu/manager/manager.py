@@ -198,26 +198,26 @@ class Manager:
         if meta is None:
             return {}
         # one admission at a time: concurrent duplicates would both pass
-        # the diff gate before either merged (TOCTOU)
+        # the diff gate before either merged (TOCTOU).  Gate + merge run
+        # as ONE fused device dispatch so the lock is held for a single
+        # tunnel round-trip (round-2 verdict weak #5)
         with self._admit_mu:
             with self._mu:
                 if sig in self.corpus:
                     return {}
-            # device admission gate: diff vs global corpus cover
             idx, valid = self.pcmap.map_batch([cover], K=256)
-            has_new, _new, bitmaps = self.engine.triage_diff(
+            has_new, rows = self.engine.admit_if_new(
                 np.array([meta.id], np.int32), idx, valid)
             if not has_new[0]:
                 with self._mu:
                     self.stats["rejected inputs"] = \
                         self.stats.get("rejected inputs", 0) + 1
                 return {}
-            rows = self.engine.merge_corpus(np.array([meta.id], np.int32),
-                                            bitmaps)
             with self._mu:
                 self.corpus[sig] = CorpusItem(
                     data=data, call=call, call_index=call_index,
-                    corpus_row=int(rows[0]) if rows is not None else -1)
+                    corpus_row=int(rows[0]) if rows is not None
+                    and len(rows) else -1)
                 self.stats["manager new inputs"] = \
                     self.stats.get("manager new inputs", 0) + 1
                 # broadcast to the other fuzzers (ref manager.go:596-621)

@@ -152,3 +152,47 @@ def test_sample_corpus_indices_row_mapping(rng):
     assert set(idx.tolist()) <= {100, 101, 102}
     # the triage gate still rejects what the full matrix absorbed
     assert len(sig.triage_new(1, np.arange(200, 220).astype(np.uint64))) == 0
+
+
+def test_admit_if_new_fused(rng):
+    """The fused gate+merge matches the two-step triage_diff +
+    merge_corpus semantics, including full-matrix refusal."""
+    npcs, C, K = 1 << 12, 4, 16
+    eng = CoverageEngine(npcs=npcs, ncalls=C, corpus_cap=2, batch=4,
+                         max_pcs_per_exec=K)
+    idx = np.zeros((1, K), np.int32)
+    idx[0, :4] = [1, 2, 3, 4]
+    valid = np.zeros((1, K), bool)
+    valid[0, :4] = True
+    has_new, rows = eng.admit_if_new(np.array([1], np.int32), idx, valid)
+    assert has_new[0] and list(rows) == [0]
+    assert eng.corpus_len == 1
+    # same cover again: rejected, nothing appended
+    has_new, rows = eng.admit_if_new(np.array([1], np.int32), idx, valid)
+    assert not has_new[0] and len(rows) == 0
+    assert eng.corpus_len == 1
+    # different call id: separate per-call cover, admitted
+    has_new, rows = eng.admit_if_new(np.array([2], np.int32), idx, valid)
+    assert has_new[0] and list(rows) == [1]
+    # matrix full: verdict still computed, nothing merges
+    idx2 = idx.copy(); idx2[0, :4] = [9, 10, 11, 12]
+    has_new, rows = eng.admit_if_new(np.array([1], np.int32), idx2, valid)
+    assert has_new[0] and rows is None
+    assert eng.corpus_len == 2
+    # and the unmerged cover stays re-discoverable
+    has_new, rows = eng.admit_if_new(np.array([1], np.int32), idx2, valid)
+    assert has_new[0]
+
+
+def test_admit_if_new_in_batch_duplicates(rng):
+    """Two identical new-coverage entries in ONE batch admit exactly one
+    row (exact sequential semantics via the fused kernel's diff_merge)."""
+    eng = CoverageEngine(npcs=1 << 12, ncalls=4, corpus_cap=8, batch=4,
+                         max_pcs_per_exec=8)
+    idx = np.tile(np.array([5, 6, 7, 8, 0, 0, 0, 0], np.int32), (2, 1))
+    valid = np.zeros((2, 8), bool)
+    valid[:, :4] = True
+    has_new, rows = eng.admit_if_new(np.array([2, 2], np.int32), idx, valid)
+    assert has_new[0] and not has_new[1]
+    assert list(rows) == [0]
+    assert eng.corpus_len == 1
