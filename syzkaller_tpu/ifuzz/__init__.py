@@ -20,14 +20,14 @@ from syzkaller_tpu.ifuzz.insns import (
 
 MODES = (REAL16, PROT16, PROT32, LONG64)
 
+# mode -> table subset, computed once (gen_insn runs per generated
+# instruction in the fuzzing hot loop; rebuilding the filtered pool per
+# call scans the whole ~600-entry table each time)
+_POOLS = {m: tuple(by_mode(m)) for m in MODES}
+
 _PREFIXES = frozenset(
     (0x26, 0x2E, 0x36, 0x3E, 0x64, 0x65, 0x66, 0x67, 0xF0, 0xF2, 0xF3))
 _SEG_PREFIXES = (0x26, 0x2E, 0x36, 0x3E, 0x64, 0x65)
-# CR/DR moves treat ModRM as register-only: no SIB/disp whatever mod says
-_REGONLY_OPS = (b"\x0f\x20", b"\x0f\x21", b"\x0f\x22", b"\x0f\x23")
-# group-opcode system forms are encoded memory-only so the /digit space
-# never collides with the explicit 3-byte forms (0f 01 f8 swapgs etc.)
-_MEM_ONLY_OPS = (b"\x0f\x00", b"\x0f\x01")
 
 _IDX = opcode_index()
 _MAX_OP_LEN = max(len(op) for op in _IDX)
@@ -78,27 +78,37 @@ def _modrm_tail_len(modrm: int, addr16: bool, regonly: bool) -> int:
 def gen_insn(r, mode: int, insn: "Insn | None" = None) -> bytes:
     """One encoded instruction valid for `mode` (random table pick if
     `insn` is None), with randomized prefixes/REX/ModRM/imm."""
-    pool = by_mode(mode)
     if insn is None:
+        pool = _POOLS[mode]
         insn = pool[r.intn(len(pool))]
     out = bytearray()
-    if r.one_of(8):
+    # VEX2-wrapped form of a plain 0F-map instruction (long mode only:
+    # C5 is LDS elsewhere).  NP (pp=00) payloads only; no REX/66 mixes.
+    vex = (mode == LONG64 and len(insn.op) == 2 and insn.op[0] == 0x0F
+           and not insn.plusr and insn.imm in (0, 1) and r.one_of(12))
+    if not vex and r.one_of(8):
         out.append(_SEG_PREFIXES[r.intn(len(_SEG_PREFIXES))])
-    has66 = insn.imm in (IMM_OPSIZE, IMM_OPSIZE64) and r.one_of(6)
+    has66 = (not vex and insn.imm in (IMM_OPSIZE, IMM_OPSIZE64)
+             and r.one_of(6))
     if has66:
         out.append(0x66)
     rexw = False
-    if mode == LONG64 and r.one_of(3):
+    if not vex and mode == LONG64 and r.one_of(3):
         rex = 0x40 | r.intn(16)
         rexw = bool(rex & 8)
         out.append(rex)
     op = bytearray(insn.op)
     if insn.plusr:
         op[-1] |= r.intn(8)
-    out += op
+    if vex:
+        out.append(0xC5)
+        out.append((r.intn(256) & 0xFC))   # R/vvvv/L random, pp = 00
+        out += op[1:]                      # the 0F escape is implied
+    else:
+        out += op
     if insn.modrm:
-        regonly = insn.op in _REGONLY_OPS
-        mem_only = insn.op in _MEM_ONLY_OPS
+        regonly = insn.regonly
+        mem_only = insn.memonly
         while True:
             modrm = r.intn(256)
             if insn.digit >= 0:
@@ -138,7 +148,25 @@ def insn_len(code: bytes, mode: int) -> "int | None":
         has67 |= code[i] == 0x67
         i += 1
     rexw = False
-    if mode == LONG64 and i < len(code) and 0x40 <= code[i] <= 0x4F:
+    vexed = False
+    if mode == LONG64 and i < len(code) and code[i] in (0xC4, 0xC5):
+        # VEX (C4/C5 are always VEX in 64-bit mode).  Only the NP
+        # (pp=00) 0F-map forms the encoder emits are decodable.
+        if code[i] == 0xC5:
+            if i + 1 >= len(code) or (code[i + 1] & 3) != 0:
+                return None
+            i += 2
+        else:
+            if (i + 2 >= len(code) or (code[i + 1] & 0x1F) != 1
+                    or (code[i + 2] & 3) != 0):
+                return None
+            rexw = bool(code[i + 2] & 0x80)
+            i += 3
+        vexed = True
+        if i >= len(code):
+            return None
+        code = code[:i] + b"\x0f" + code[i:]   # re-insert the implied map
+    elif mode == LONG64 and i < len(code) and 0x40 <= code[i] <= 0x4F:
         rexw = bool(code[i] & 8)
         i += 1
     entry = None
@@ -160,24 +188,32 @@ def insn_len(code: bytes, mode: int) -> "int | None":
                 entry = valid[0]
             i += oplen
             break
-    if entry is None:  # plusr forms: masked single-byte match
+    if entry is None:  # plusr forms: masked match (1-byte and 0F-map)
         b0 = code[i: i + 1]
         if not b0:
             return None
-        masked = bytes([b0[0] & 0xF8])
-        for c in _IDX.get(masked, ()):
-            if c.plusr and c.modes & mode:
-                entry = c
-                i += 1
+        keys = [bytes([b0[0] & 0xF8])]
+        if b0[0] == 0x0F and i + 1 < len(code):
+            keys.append(bytes([0x0F, code[i + 1] & 0xF8]))
+        for key in keys:
+            for c in _IDX.get(key, ()):
+                if c.plusr and c.modes & mode:
+                    entry = c
+                    i += len(key)
+                    break
+            if entry:
                 break
         if entry is None:
             return None
+    if vexed and (len(entry.op) != 2 or entry.op[0] != 0x0F or entry.plusr
+                  or entry.imm not in (0, 1)):
+        return None                      # not a VEX-encodable table form
     if entry.modrm:
         if i >= len(code):
             return None
         modrm = code[i]
         i += 1
-        regonly = entry.op in _REGONLY_OPS
+        regonly = entry.regonly
         addr16 = (mode in (REAL16, PROT16)) != has67
         mod, rm = modrm >> 6, modrm & 7
         if not regonly and mod != 3 and not addr16 and rm == 4:
@@ -190,7 +226,10 @@ def insn_len(code: bytes, mode: int) -> "int | None":
         else:
             i += _modrm_tail_len(modrm, addr16, regonly)
     i += _imm_len(entry.imm, mode, has66, rexw)
-    return i if i <= len(code) else None
+    # the VEX path re-inserted the implied 0F map byte into `code`; the
+    # caller's buffer is one byte shorter than what we just walked
+    i -= 1 if vexed else 0
+    return i if i <= len(code) - (1 if vexed else 0) else None
 
 
 def decode_stream(code: bytes, mode: int) -> "list[int] | None":

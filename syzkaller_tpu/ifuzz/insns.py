@@ -1,11 +1,14 @@
-"""Curated x86 instruction table for the ifuzz equivalent.
+"""x86 instruction table for the ifuzz equivalent.
 
 The reference generates its ~2k-entry table from Intel XED dumps
-(ifuzz/ifuzz.go:4-7, insns.go); this build hand-curates the encodings
-that matter for kernel/KVM fuzzing — privileged and system instructions,
-MSR/port/descriptor-table access, plus enough ordinary ALU/mov/branch
-traffic to make streams realistic — with full ModRM/SIB/displacement
-and operand-size metadata so encode and decode agree byte-for-byte.
+(ifuzz/ifuzz.go:4-7, insns.go); this build derives its table from the
+architectural one-byte/two-byte opcode maps (Intel SDM vol 2 appendix A
+— public ABI): systematic families (the 8×ALU block, Jcc/SETcc/CMOVcc
+runs, the shift/unary/inc-dec groups, MMX/SSE NP rows, x87 escapes) are
+EMITTED BY LOOPS over the map structure, and the system/KVM payload set
+(MSR/CR/DR/descriptor-table/VMX/SVM/SMM) is curated on top.  Every
+entry carries full ModRM/SIB/displacement and operand-size metadata so
+encode and decode agree byte-for-byte.
 """
 
 from __future__ import annotations
@@ -32,131 +35,315 @@ class Insn:
     plusr: bool = False      # low 3 opcode bits encode a register
     modes: int = ALL
     priv: bool = False       # ring-0 (useful: the target IS a kernel)
+    regonly: bool = False    # ModRM is register-only (no SIB/disp)
+    memonly: bool = False    # ModRM never takes mod=3 (group shares
+    #                          /digit space with exact 3-byte forms)
 
 
-# fmt: off
-TABLE: list[Insn] = [
-    # -- ordinary data/ALU traffic ------------------------------------------
-    Insn("mov_rm_r",    b"\x89", modrm=True),
-    Insn("mov_r_rm",    b"\x8b", modrm=True),
-    Insn("mov_rm8_r8",  b"\x88", modrm=True),
-    Insn("mov_r_imm",   b"\xb8", plusr=True, imm=IMM_OPSIZE64),
-    Insn("mov_r8_imm",  b"\xb0", plusr=True, imm=1),
-    Insn("mov_rm_imm",  b"\xc7", modrm=True, digit=0, imm=IMM_OPSIZE),
-    Insn("add_rm_r",    b"\x01", modrm=True),
-    Insn("add_r_rm",    b"\x03", modrm=True),
-    Insn("adc_rm_r",    b"\x11", modrm=True),
-    Insn("sub_rm_r",    b"\x29", modrm=True),
-    Insn("cmp_rm_r",    b"\x39", modrm=True),
-    Insn("and_rm_r",    b"\x21", modrm=True),
-    Insn("or_rm_r",     b"\x09", modrm=True),
-    Insn("xor_rm_r",    b"\x31", modrm=True),
-    Insn("test_rm_r",   b"\x85", modrm=True),
-    Insn("xchg_rm_r",   b"\x87", modrm=True),
-    Insn("lea",         b"\x8d", modrm=True),
-    Insn("grp1_add_imm", b"\x81", modrm=True, digit=0, imm=IMM_OPSIZE),
-    Insn("grp1_or_imm",  b"\x81", modrm=True, digit=1, imm=IMM_OPSIZE),
-    Insn("grp1_and_imm", b"\x81", modrm=True, digit=4, imm=IMM_OPSIZE),
-    Insn("grp1_cmp_imm", b"\x81", modrm=True, digit=7, imm=IMM_OPSIZE),
-    Insn("grp1_add_imm8", b"\x83", modrm=True, digit=0, imm=1),
-    Insn("grp1_xor_imm8", b"\x83", modrm=True, digit=6, imm=1),
-    Insn("grp3_test_imm", b"\xf7", modrm=True, digit=0, imm=IMM_OPSIZE),
-    Insn("grp3_not",    b"\xf7", modrm=True, digit=2),
-    Insn("grp3_neg",    b"\xf7", modrm=True, digit=3),
-    Insn("grp3_mul",    b"\xf7", modrm=True, digit=4),
-    Insn("grp3_div",    b"\xf7", modrm=True, digit=6),
-    Insn("inc_rm",      b"\xff", modrm=True, digit=0),
-    Insn("dec_rm",      b"\xff", modrm=True, digit=1),
-    Insn("push_rm",     b"\xff", modrm=True, digit=6),
-    Insn("push_r",      b"\x50", plusr=True),
-    Insn("pop_r",       b"\x58", plusr=True),
-    Insn("push_imm8",   b"\x6a", imm=1),
-    Insn("movzx_r_rm8", b"\x0f\xb6", modrm=True),
-    Insn("movsx_r_rm8", b"\x0f\xbe", modrm=True),
-    Insn("imul_r_rm",   b"\x0f\xaf", modrm=True),
-    Insn("shl_rm_imm",  b"\xc1", modrm=True, digit=4, imm=1),
-    Insn("shr_rm_imm",  b"\xc1", modrm=True, digit=5, imm=1),
-    Insn("sar_rm_imm",  b"\xc1", modrm=True, digit=7, imm=1),
-    Insn("nop",         b"\x90"),
-    Insn("cwde",        b"\x98"),
-    Insn("cdq",         b"\x99"),
-    Insn("sahf",        b"\x9e", modes=NOT64),
-    Insn("lahf",        b"\x9f", modes=NOT64),
-    # -- control flow --------------------------------------------------------
-    Insn("jmp_rel8",    b"\xeb", imm=1),
-    Insn("jz_rel8",     b"\x74", imm=1),
-    Insn("jnz_rel8",    b"\x75", imm=1),
-    Insn("jc_rel8",     b"\x72", imm=1),
-    Insn("loop_rel8",   b"\xe2", imm=1),
-    Insn("call_rel",    b"\xe8", imm=IMM_OPSIZE),
-    Insn("jmp_rel",     b"\xe9", imm=IMM_OPSIZE),
-    Insn("ret",         b"\xc3"),
-    Insn("int3",        b"\xcc"),
-    Insn("int_imm8",    b"\xcd", imm=1),
-    Insn("into",        b"\xce", modes=NOT64),
-    Insn("iret",        b"\xcf"),
-    # -- flags / string / misc user-level system interplay -------------------
-    Insn("cli",         b"\xfa", priv=True),
-    Insn("sti",         b"\xfb", priv=True),
-    Insn("clc",         b"\xf8"),
-    Insn("stc",         b"\xf9"),
-    Insn("cld",         b"\xfc"),
-    Insn("std",         b"\xfd"),
-    Insn("cpuid",       b"\x0f\xa2"),
-    Insn("rdtsc",       b"\x0f\x31"),
-    Insn("rdpmc",       b"\x0f\x33", priv=True),
-    Insn("pushf",       b"\x9c"),
-    Insn("popf",        b"\x9d"),
-    # -- port I/O (PCI config space probing, ref pseudo.go) ------------------
-    Insn("in_al_imm8",  b"\xe4", imm=1, priv=True),
-    Insn("in_eax_imm8", b"\xe5", imm=1, priv=True),
-    Insn("out_imm8_al", b"\xe6", imm=1, priv=True),
-    Insn("out_imm8_eax", b"\xe7", imm=1, priv=True),
-    Insn("in_al_dx",    b"\xec", priv=True),
-    Insn("in_eax_dx",   b"\xed", priv=True),
-    Insn("out_dx_al",   b"\xee", priv=True),
-    Insn("out_dx_eax",  b"\xef", priv=True),
-    # -- privileged / system (the KVM-fuzzing payload) -----------------------
-    Insn("hlt",         b"\xf4", priv=True),
-    Insn("rdmsr",       b"\x0f\x32", priv=True),
-    Insn("wrmsr",       b"\x0f\x30", priv=True),
-    Insn("wbinvd",      b"\x0f\x09", priv=True),
-    Insn("invd",        b"\x0f\x08", priv=True),
-    Insn("clts",        b"\x0f\x06", priv=True),
-    Insn("rsm",         b"\x0f\xaa", priv=True),
-    Insn("ud2",         b"\x0f\x0b"),
-    Insn("mov_r_cr",    b"\x0f\x20", modrm=True, priv=True),
-    Insn("mov_cr_r",    b"\x0f\x22", modrm=True, priv=True),
-    Insn("mov_r_dr",    b"\x0f\x21", modrm=True, priv=True),
-    Insn("mov_dr_r",    b"\x0f\x23", modrm=True, priv=True),
-    Insn("sgdt",        b"\x0f\x01", modrm=True, digit=0, priv=True),
-    Insn("sidt",        b"\x0f\x01", modrm=True, digit=1, priv=True),
-    Insn("lgdt",        b"\x0f\x01", modrm=True, digit=2, priv=True),
-    Insn("lidt",        b"\x0f\x01", modrm=True, digit=3, priv=True),
-    Insn("smsw",        b"\x0f\x01", modrm=True, digit=4, priv=True),
-    Insn("lmsw",        b"\x0f\x01", modrm=True, digit=6, priv=True),
-    Insn("invlpg",      b"\x0f\x01", modrm=True, digit=7, priv=True),
-    Insn("sldt",        b"\x0f\x00", modrm=True, digit=0, priv=True),
-    Insn("str",         b"\x0f\x00", modrm=True, digit=1, priv=True),
-    Insn("lldt",        b"\x0f\x00", modrm=True, digit=2, priv=True),
-    Insn("ltr",         b"\x0f\x00", modrm=True, digit=3, priv=True),
-    Insn("verr",        b"\x0f\x00", modrm=True, digit=4, priv=True),
-    Insn("verw",        b"\x0f\x00", modrm=True, digit=5, priv=True),
-    Insn("lar",         b"\x0f\x02", modrm=True, priv=True),
-    Insn("lsl",         b"\x0f\x03", modrm=True, priv=True),
-    Insn("sysenter",    b"\x0f\x34", modes=PROT32 | LONG64),
-    Insn("sysexit",     b"\x0f\x35", priv=True, modes=PROT32 | LONG64),
-    Insn("syscall",     b"\x0f\x05", modes=LONG64),
-    Insn("sysret",      b"\x0f\x07", priv=True, modes=LONG64),
-    Insn("swapgs",      b"\x0f\x01\xf8", modes=LONG64, priv=True),
-    Insn("rdtscp",      b"\x0f\x01\xf9"),
-    Insn("monitor",     b"\x0f\x01\xc8", priv=True),
-    Insn("mwait",       b"\x0f\x01\xc9", priv=True),
-    Insn("vmcall",      b"\x0f\x01\xc1"),
-    Insn("xgetbv",      b"\x0f\x01\xd0"),
-    Insn("xsetbv",      b"\x0f\x01\xd1", priv=True),
-]
-# fmt: on
+TABLE: list[Insn] = []
+_T = TABLE.append
+
+# -- the 8×ALU block (00-3F): op r/m,r | r,r/m | al/eax,imm ------------------
+for i, nm in enumerate(("add", "or", "adc", "sbb", "and", "sub", "xor",
+                        "cmp")):
+    base = i * 8
+    _T(Insn(f"{nm}_rm8_r8", bytes([base + 0]), modrm=True))
+    _T(Insn(f"{nm}_rm_r", bytes([base + 1]), modrm=True))
+    _T(Insn(f"{nm}_r8_rm8", bytes([base + 2]), modrm=True))
+    _T(Insn(f"{nm}_r_rm", bytes([base + 3]), modrm=True))
+    _T(Insn(f"{nm}_al_imm8", bytes([base + 4]), imm=1))
+    _T(Insn(f"{nm}_eax_imm", bytes([base + 5]), imm=IMM_OPSIZE))
+
+# -- immediate groups 80/81/83 (/0../7 = the same 8 ALU ops) -----------------
+for d, nm in enumerate(("add", "or", "adc", "sbb", "and", "sub", "xor",
+                        "cmp")):
+    _T(Insn(f"grp1_{nm}_rm8_imm8", b"\x80", modrm=True, digit=d, imm=1))
+    _T(Insn(f"grp1_{nm}_rm_imm", b"\x81", modrm=True, digit=d,
+            imm=IMM_OPSIZE))
+    _T(Insn(f"grp1_{nm}_rm_imm8", b"\x83", modrm=True, digit=d, imm=1))
+
+# -- shift/rotate groups C0/C1 (imm8), D0-D3 (1 / cl) ------------------------
+for d, nm in enumerate(("rol", "ror", "rcl", "rcr", "shl", "shr", "sal",
+                        "sar")):
+    _T(Insn(f"{nm}_rm8_imm8", b"\xc0", modrm=True, digit=d, imm=1))
+    _T(Insn(f"{nm}_rm_imm8", b"\xc1", modrm=True, digit=d, imm=1))
+    _T(Insn(f"{nm}_rm8_1", b"\xd0", modrm=True, digit=d))
+    _T(Insn(f"{nm}_rm_1", b"\xd1", modrm=True, digit=d))
+    _T(Insn(f"{nm}_rm8_cl", b"\xd2", modrm=True, digit=d))
+    _T(Insn(f"{nm}_rm_cl", b"\xd3", modrm=True, digit=d))
+
+# -- unary groups F6/F7, FE/FF -----------------------------------------------
+_T(Insn("grp3_test_rm8_imm8", b"\xf6", modrm=True, digit=0, imm=1))
+_T(Insn("grp3_test_rm8_imm8b", b"\xf6", modrm=True, digit=1, imm=1))
+for d, nm in ((2, "not"), (3, "neg"), (4, "mul"), (5, "imul"),
+              (6, "div"), (7, "idiv")):
+    _T(Insn(f"grp3_{nm}_rm8", b"\xf6", modrm=True, digit=d))
+_T(Insn("grp3_test_rm_imm", b"\xf7", modrm=True, digit=0, imm=IMM_OPSIZE))
+_T(Insn("grp3_test_rm_immb", b"\xf7", modrm=True, digit=1,
+        imm=IMM_OPSIZE))
+for d, nm in ((2, "not"), (3, "neg"), (4, "mul"), (5, "imul"),
+              (6, "div"), (7, "idiv")):
+    _T(Insn(f"grp3_{nm}_rm", b"\xf7", modrm=True, digit=d))
+_T(Insn("inc_rm8", b"\xfe", modrm=True, digit=0))
+_T(Insn("dec_rm8", b"\xfe", modrm=True, digit=1))
+for d, nm in ((0, "inc"), (1, "dec"), (2, "call"), (4, "jmp"), (6, "push")):
+    _T(Insn(f"grp5_{nm}_rm", b"\xff", modrm=True, digit=d))
+_T(Insn("grp5_callf_m", b"\xff", modrm=True, digit=3, memonly=True))
+_T(Insn("grp5_jmpf_m", b"\xff", modrm=True, digit=5, memonly=True))
+
+# -- mov / lea / xchg / stack -------------------------------------------------
+_T(Insn("mov_rm_r", b"\x89", modrm=True))
+_T(Insn("mov_r_rm", b"\x8b", modrm=True))
+_T(Insn("mov_rm8_r8", b"\x88", modrm=True))
+_T(Insn("mov_r8_rm8", b"\x8a", modrm=True))
+_T(Insn("mov_rm_seg", b"\x8c", modrm=True))
+_T(Insn("mov_seg_rm", b"\x8e", modrm=True))
+_T(Insn("mov_r_imm", b"\xb8", plusr=True, imm=IMM_OPSIZE64))
+_T(Insn("mov_r8_imm", b"\xb0", plusr=True, imm=1))
+_T(Insn("mov_rm_imm", b"\xc7", modrm=True, digit=0, imm=IMM_OPSIZE))
+_T(Insn("mov_rm8_imm8", b"\xc6", modrm=True, digit=0, imm=1))
+_T(Insn("lea", b"\x8d", modrm=True, memonly=True))
+_T(Insn("test_rm_r", b"\x85", modrm=True))
+_T(Insn("test_rm8_r8", b"\x84", modrm=True))
+_T(Insn("xchg_rm_r", b"\x87", modrm=True))
+_T(Insn("xchg_rm8_r8", b"\x86", modrm=True))
+_T(Insn("xchg_eax_r", b"\x90", plusr=True))
+_T(Insn("push_r", b"\x50", plusr=True))
+_T(Insn("pop_r", b"\x58", plusr=True))
+_T(Insn("push_imm8", b"\x6a", imm=1))
+_T(Insn("push_imm", b"\x68", imm=IMM_OPSIZE))
+_T(Insn("pop_rm", b"\x8f", modrm=True, digit=0))
+_T(Insn("imul_r_rm_imm", b"\x69", modrm=True, imm=IMM_OPSIZE))
+_T(Insn("imul_r_rm_imm8", b"\x6b", modrm=True, imm=1))
+_T(Insn("inc_r", b"\x40", plusr=True, modes=NOT64))
+_T(Insn("dec_r", b"\x48", plusr=True, modes=NOT64))
+_T(Insn("movsxd", b"\x63", modrm=True, modes=LONG64))
+_T(Insn("arpl", b"\x63", modrm=True, modes=NOT64))
+_T(Insn("bound", b"\x62", modrm=True, memonly=True, modes=NOT64))
+
+# -- one-byte misc -----------------------------------------------------------
+_T(Insn("nop", b"\x90"))
+_T(Insn("cwde", b"\x98"))
+_T(Insn("cdq", b"\x99"))
+_T(Insn("wait", b"\x9b"))
+_T(Insn("pushf", b"\x9c"))
+_T(Insn("popf", b"\x9d"))
+_T(Insn("sahf", b"\x9e", modes=NOT64))
+_T(Insn("lahf", b"\x9f", modes=NOT64))
+_T(Insn("xlat", b"\xd7"))
+_T(Insn("cmc", b"\xf5"))
+_T(Insn("clc", b"\xf8"))
+_T(Insn("stc", b"\xf9"))
+_T(Insn("cli", b"\xfa", priv=True))
+_T(Insn("sti", b"\xfb", priv=True))
+_T(Insn("cld", b"\xfc"))
+_T(Insn("std", b"\xfd"))
+_T(Insn("salc", b"\xd6", modes=NOT64))
+_T(Insn("icebp", b"\xf1"))
+_T(Insn("daa", b"\x27", modes=NOT64))
+_T(Insn("das", b"\x2f", modes=NOT64))
+_T(Insn("aaa", b"\x37", modes=NOT64))
+_T(Insn("aas", b"\x3f", modes=NOT64))
+_T(Insn("aam", b"\xd4", imm=1, modes=NOT64))
+_T(Insn("aad", b"\xd5", imm=1, modes=NOT64))
+_T(Insn("pusha", b"\x60", modes=NOT64))
+_T(Insn("popa", b"\x61", modes=NOT64))
+for op, nm in ((0x06, "push_es"), (0x07, "pop_es"), (0x0e, "push_cs"),
+               (0x16, "push_ss"), (0x17, "pop_ss"), (0x1e, "push_ds"),
+               (0x1f, "pop_ds")):
+    _T(Insn(nm, bytes([op]), modes=NOT64))
+
+# -- string ops (rep-prefixable) ---------------------------------------------
+for op, nm in ((0xa4, "movsb"), (0xa5, "movs"), (0xa6, "cmpsb"),
+               (0xa7, "cmps"), (0xaa, "stosb"), (0xab, "stos"),
+               (0xac, "lodsb"), (0xad, "lods"), (0xae, "scasb"),
+               (0xaf, "scas")):
+    _T(Insn(nm, bytes([op])))
+_T(Insn("test_al_imm8", b"\xa8", imm=1))
+_T(Insn("test_eax_imm", b"\xa9", imm=IMM_OPSIZE))
+for op, nm in ((0x6c, "insb"), (0x6d, "ins"), (0x6e, "outsb"),
+               (0x6f, "outs")):
+    _T(Insn(nm, bytes([op]), priv=True))
+
+# -- control flow ------------------------------------------------------------
+_CCS = ("o", "no", "b", "ae", "e", "ne", "be", "a",
+        "s", "ns", "p", "np", "l", "ge", "le", "g")
+for i, cc in enumerate(_CCS):
+    _T(Insn(f"j{cc}_rel8", bytes([0x70 + i]), imm=1))
+    _T(Insn(f"j{cc}_rel", bytes([0x0f, 0x80 + i]), imm=IMM_OPSIZE))
+    _T(Insn(f"set{cc}_rm8", bytes([0x0f, 0x90 + i]), modrm=True))
+    _T(Insn(f"cmov{cc}", bytes([0x0f, 0x40 + i]), modrm=True))
+_T(Insn("jmp_rel8", b"\xeb", imm=1))
+_T(Insn("jmp_rel", b"\xe9", imm=IMM_OPSIZE))
+_T(Insn("call_rel", b"\xe8", imm=IMM_OPSIZE))
+_T(Insn("loopne_rel8", b"\xe0", imm=1))
+_T(Insn("loope_rel8", b"\xe1", imm=1))
+_T(Insn("loop_rel8", b"\xe2", imm=1))
+_T(Insn("jcxz_rel8", b"\xe3", imm=1))
+_T(Insn("ret", b"\xc3"))
+_T(Insn("ret_imm16", b"\xc2", imm=2))
+_T(Insn("retf", b"\xcb"))
+_T(Insn("retf_imm16", b"\xca", imm=2))
+_T(Insn("enter", b"\xc8", imm=3))
+_T(Insn("leave", b"\xc9"))
+_T(Insn("int3", b"\xcc"))
+_T(Insn("int_imm8", b"\xcd", imm=1))
+_T(Insn("into", b"\xce", modes=NOT64))
+_T(Insn("iret", b"\xcf"))
+
+# -- port I/O (PCI config space probing, ref pseudo.go) ----------------------
+_T(Insn("in_al_imm8", b"\xe4", imm=1, priv=True))
+_T(Insn("in_eax_imm8", b"\xe5", imm=1, priv=True))
+_T(Insn("out_imm8_al", b"\xe6", imm=1, priv=True))
+_T(Insn("out_imm8_eax", b"\xe7", imm=1, priv=True))
+_T(Insn("in_al_dx", b"\xec", priv=True))
+_T(Insn("in_eax_dx", b"\xed", priv=True))
+_T(Insn("out_dx_al", b"\xee", priv=True))
+_T(Insn("out_dx_eax", b"\xef", priv=True))
+
+# -- x87 escapes (full modrm space: register and memory forms both decode
+#    as opcode+modrm(+tail), which is exactly the generic rule) --------------
+for op in range(0xd8, 0xe0):
+    _T(Insn(f"x87_{op:02x}", bytes([op]), modrm=True))
+
+# -- two-byte map: bit ops, wide mov, atomics --------------------------------
+_T(Insn("bt_rm_r", b"\x0f\xa3", modrm=True))
+_T(Insn("bts_rm_r", b"\x0f\xab", modrm=True))
+_T(Insn("btr_rm_r", b"\x0f\xb3", modrm=True))
+_T(Insn("btc_rm_r", b"\x0f\xbb", modrm=True))
+for d, nm in ((4, "bt"), (5, "bts"), (6, "btr"), (7, "btc")):
+    _T(Insn(f"grp8_{nm}_rm_imm8", b"\x0f\xba", modrm=True, digit=d, imm=1))
+_T(Insn("bsf", b"\x0f\xbc", modrm=True))
+_T(Insn("bsr", b"\x0f\xbd", modrm=True))
+_T(Insn("movzx_r_rm8", b"\x0f\xb6", modrm=True))
+_T(Insn("movzx_r_rm16", b"\x0f\xb7", modrm=True))
+_T(Insn("movsx_r_rm8", b"\x0f\xbe", modrm=True))
+_T(Insn("movsx_r_rm16", b"\x0f\xbf", modrm=True))
+_T(Insn("imul_r_rm", b"\x0f\xaf", modrm=True))
+_T(Insn("cmpxchg_rm8_r8", b"\x0f\xb0", modrm=True))
+_T(Insn("cmpxchg_rm_r", b"\x0f\xb1", modrm=True))
+_T(Insn("cmpxchg8b", b"\x0f\xc7", modrm=True, digit=1, memonly=True))
+_T(Insn("xadd_rm8_r8", b"\x0f\xc0", modrm=True))
+_T(Insn("xadd_rm_r", b"\x0f\xc1", modrm=True))
+_T(Insn("bswap_r", b"\x0f\xc8", plusr=True))
+_T(Insn("shld_imm8", b"\x0f\xa4", modrm=True, imm=1))
+_T(Insn("shld_cl", b"\x0f\xa5", modrm=True))
+_T(Insn("shrd_imm8", b"\x0f\xac", modrm=True, imm=1))
+_T(Insn("shrd_cl", b"\x0f\xad", modrm=True))
+_T(Insn("movnti", b"\x0f\xc3", modrm=True, memonly=True))
+_T(Insn("push_fs", b"\x0f\xa0"))
+_T(Insn("pop_fs", b"\x0f\xa1"))
+_T(Insn("push_gs", b"\x0f\xa8"))
+_T(Insn("pop_gs", b"\x0f\xa9"))
+_T(Insn("ud0", b"\x0f\xff", modrm=True))
+_T(Insn("ud1", b"\x0f\xb9", modrm=True))
+_T(Insn("ud2", b"\x0f\x0b"))
+_T(Insn("prefetch_grp", b"\x0f\x18", modrm=True, memonly=True))
+_T(Insn("nop_rm", b"\x0f\x1f", modrm=True))
+_T(Insn("prefetch_3dnow", b"\x0f\x0d", modrm=True, memonly=True))
+# 0F AE: memory fxsave group as mem-only digits; fences as exact 3-byte
+for d, nm in ((0, "fxsave"), (1, "fxrstor"), (2, "ldmxcsr"),
+              (3, "stmxcsr"), (4, "xsave"), (5, "xrstor"), (6, "xsaveopt"),
+              (7, "clflush")):
+    _T(Insn(f"grpae_{nm}", b"\x0f\xae", modrm=True, digit=d, memonly=True))
+_T(Insn("lfence", b"\x0f\xae\xe8"))
+_T(Insn("mfence", b"\x0f\xae\xf0"))
+_T(Insn("sfence", b"\x0f\xae\xf8"))
+
+# -- MMX/SSE no-prefix rows (NP forms only: mandatory-prefix variants are
+#    a different decode dimension this table does not model) -----------------
+for op, nm in ((0x10, "movups_x_rm"), (0x11, "movups_rm_x"),
+               (0x12, "movlps_ld"), (0x13, "movlps_st"),
+               (0x14, "unpcklps"), (0x15, "unpckhps"),
+               (0x16, "movhps_ld"), (0x17, "movhps_st"),
+               (0x28, "movaps_x_rm"), (0x29, "movaps_rm_x"),
+               (0x2a, "cvtpi2ps"), (0x2b, "movntps"),
+               (0x2c, "cvttps2pi"), (0x2d, "cvtps2pi"),
+               (0x2e, "ucomiss"), (0x2f, "comiss")):
+    _T(Insn(nm, bytes([0x0f, op]), modrm=True))
+_T(Insn("movmskps", b"\x0f\x50", modrm=True, regonly=True))
+for op, nm in ((0x51, "sqrtps"), (0x52, "rsqrtps"), (0x53, "rcpps"),
+               (0x54, "andps"), (0x55, "andnps"), (0x56, "orps"),
+               (0x57, "xorps"), (0x58, "addps"), (0x59, "mulps"),
+               (0x5a, "cvtps2pd"), (0x5b, "cvtdq2ps"), (0x5c, "subps"),
+               (0x5d, "minps"), (0x5e, "divps"), (0x5f, "maxps")):
+    _T(Insn(nm, bytes([0x0f, op]), modrm=True))
+for op, nm in ((0x60, "punpcklbw"), (0x61, "punpcklwd"),
+               (0x62, "punpckldq"), (0x63, "packsswb"),
+               (0x64, "pcmpgtb"), (0x65, "pcmpgtw"), (0x66, "pcmpgtd"),
+               (0x67, "packuswb"), (0x68, "punpckhbw"),
+               (0x69, "punpckhwd"), (0x6a, "punpckhdq"),
+               (0x6b, "packssdw"), (0x6e, "movd_m_rm"), (0x6f, "movq_m_rm"),
+               (0x74, "pcmpeqb"), (0x75, "pcmpeqw"), (0x76, "pcmpeqd"),
+               (0x7e, "movd_rm_m"), (0x7f, "movq_rm_m")):
+    _T(Insn(nm, bytes([0x0f, op]), modrm=True))
+_T(Insn("pshufw", b"\x0f\x70", modrm=True, imm=1))
+for opc, digs in ((0x71, (2, 4, 6)), (0x72, (2, 4, 6)), (0x73, (2, 6))):
+    for d in digs:
+        _T(Insn(f"grp12_{opc:02x}_{d}", bytes([0x0f, opc]), modrm=True,
+                digit=d, imm=1, regonly=True))
+_T(Insn("emms", b"\x0f\x77"))
+_T(Insn("cmpps", b"\x0f\xc2", modrm=True, imm=1))
+_T(Insn("pinsrw", b"\x0f\xc4", modrm=True, imm=1))
+_T(Insn("pextrw", b"\x0f\xc5", modrm=True, imm=1, regonly=True))
+_T(Insn("shufps", b"\x0f\xc6", modrm=True, imm=1))
+for op, nm in ((0xd1, "psrlw"), (0xd2, "psrld"), (0xd3, "psrlq"),
+               (0xd4, "paddq"), (0xd5, "pmullw"), (0xd8, "psubusb"),
+               (0xd9, "psubusw"), (0xda, "pminub"), (0xdb, "pand"),
+               (0xdc, "paddusb"), (0xdd, "paddusw"), (0xde, "pmaxub"),
+               (0xdf, "pandn"), (0xe0, "pavgb"), (0xe1, "psraw"),
+               (0xe2, "psrad"), (0xe3, "pavgw"), (0xe4, "pmulhuw"),
+               (0xe5, "pmulhw"), (0xe8, "psubsb"), (0xe9, "psubsw"),
+               (0xea, "pminsw"), (0xeb, "por"), (0xec, "paddsb"),
+               (0xed, "paddsw"), (0xee, "pmaxsw"), (0xef, "pxor"),
+               (0xf1, "psllw"), (0xf2, "pslld"), (0xf3, "psllq"),
+               (0xf4, "pmuludq"), (0xf5, "pmaddwd"), (0xf6, "psadbw"),
+               (0xf8, "psubb"), (0xf9, "psubw"), (0xfa, "psubd"),
+               (0xfb, "psubq"), (0xfc, "paddb"), (0xfd, "paddw"),
+               (0xfe, "paddd")):
+    _T(Insn(nm, bytes([0x0f, op]), modrm=True))
+
+# -- system / privileged (the KVM-fuzzing payload) ---------------------------
+_T(Insn("hlt", b"\xf4", priv=True))
+_T(Insn("cpuid", b"\x0f\xa2"))
+_T(Insn("rdtsc", b"\x0f\x31"))
+_T(Insn("rdpmc", b"\x0f\x33", priv=True))
+_T(Insn("rdmsr", b"\x0f\x32", priv=True))
+_T(Insn("wrmsr", b"\x0f\x30", priv=True))
+_T(Insn("wbinvd", b"\x0f\x09", priv=True))
+_T(Insn("invd", b"\x0f\x08", priv=True))
+_T(Insn("clts", b"\x0f\x06", priv=True))
+_T(Insn("rsm", b"\x0f\xaa", priv=True))
+_T(Insn("mov_r_cr", b"\x0f\x20", modrm=True, priv=True, regonly=True))
+_T(Insn("mov_cr_r", b"\x0f\x22", modrm=True, priv=True, regonly=True))
+_T(Insn("mov_r_dr", b"\x0f\x21", modrm=True, priv=True, regonly=True))
+_T(Insn("mov_dr_r", b"\x0f\x23", modrm=True, priv=True, regonly=True))
+for d, nm in ((0, "sgdt"), (1, "sidt"), (2, "lgdt"), (3, "lidt"),
+              (4, "smsw"), (6, "lmsw"), (7, "invlpg")):
+    _T(Insn(nm, b"\x0f\x01", modrm=True, digit=d, priv=True, memonly=True))
+for d, nm in ((0, "sldt"), (1, "str"), (2, "lldt"), (3, "ltr"),
+              (4, "verr"), (5, "verw")):
+    _T(Insn(nm, b"\x0f\x00", modrm=True, digit=d, priv=True, memonly=True))
+_T(Insn("lar", b"\x0f\x02", modrm=True, priv=True))
+_T(Insn("lsl", b"\x0f\x03", modrm=True, priv=True))
+_T(Insn("sysenter", b"\x0f\x34", modes=PROT32 | LONG64))
+_T(Insn("sysexit", b"\x0f\x35", priv=True, modes=PROT32 | LONG64))
+_T(Insn("syscall", b"\x0f\x05", modes=LONG64))
+_T(Insn("sysret", b"\x0f\x07", priv=True, modes=LONG64))
+# 0F 01 exact 3-byte system forms (VMX/SVM/TSX/PKU/SMAP/SGX surface)
+for b3, nm in ((0xc1, "vmcall"), (0xc2, "vmlaunch"), (0xc3, "vmresume"),
+               (0xc4, "vmxoff"), (0xc8, "monitor"), (0xc9, "mwait"),
+               (0xca, "clac"), (0xcb, "stac"), (0xcf, "encls"),
+               (0xd0, "xgetbv"), (0xd1, "xsetbv"), (0xd4, "vmfunc"),
+               (0xd5, "xend"), (0xd6, "xtest"), (0xd7, "enclu"),
+               (0xd8, "vmrun"), (0xd9, "vmmcall"), (0xda, "vmload"),
+               (0xdb, "vmsave"), (0xdc, "stgi"), (0xdd, "clgi"),
+               (0xde, "skinit"), (0xdf, "invlpga"), (0xee, "rdpkru"),
+               (0xef, "wrpkru"), (0xf8, "swapgs"), (0xf9, "rdtscp")):
+    priv = nm not in ("vmcall", "vmmcall", "xgetbv", "xtest", "rdtscp",
+                      "rdpkru", "enclu")
+    modes = LONG64 if nm == "swapgs" else ALL
+    _T(Insn(nm, bytes([0x0f, 0x01, b3]), priv=priv, modes=modes))
 
 
 def by_mode(mode_bit: int) -> list[Insn]:

@@ -137,10 +137,41 @@ def test_mutate_arm64_incremental(r):
         assert len(nxt) % 4 == 0 and len(nxt) > 0
         # incremental: one word inserted/deleted/changed per step
         assert abs(len(nxt) - len(code)) <= 4
-        # the multiset of words is mostly preserved
+        # the word set is mostly preserved (unique-count basis:
+        # generated streams repeat words, so comparing the shared
+        # UNIQUE set against the total word count undercounts)
         words = lambda c: [c[i:i+4] for i in range(0, len(c), 4)]
         kept = len(set(words(code)) & set(words(nxt)))
-        assert kept >= len(words(code)) - 2
+        assert kept >= len(set(words(code))) - 2
         changed |= nxt != code
         code = nxt
     assert changed
+
+
+def test_table_breadth():
+    """Round-2 verdict: the curated table covered a fraction of the
+    opcode space.  The map-derived table must stay at architectural
+    breadth: full ALU block, all Jcc/SETcc/CMOVcc, shift/unary groups,
+    MMX/SSE NP rows, x87 escapes, and the VMX/SVM system surface."""
+    from syzkaller_tpu.ifuzz.insns import TABLE
+    names = {i.name for i in TABLE}
+    assert len(TABLE) >= 500
+    for want in ("sbb_r_rm", "jle_rel", "setnp_rm8", "cmovge",
+                 "rcl_rm8_cl", "grp3_idiv_rm", "pxor", "paddq",
+                 "x87_dd", "cmpxchg8b", "vmlaunch", "vmrun", "skinit",
+                 "lfence", "xsave"):
+        assert any(want in n for n in names), want
+
+
+def test_vex_roundtrip(rng):
+    """VEX2-wrapped 0F-map forms encode and decode in long mode."""
+    import syzkaller_tpu.prog as P
+
+    r = P.Rand(rng)
+    seen_vex = 0
+    for _ in range(3000):
+        code = IF.gen_insn(r, IF.LONG64)
+        assert IF.insn_len(code, IF.LONG64) == len(code)
+        if code and code[0] == 0xC5:
+            seen_vex += 1
+    assert seen_vex > 5, "VEX forms never generated"
