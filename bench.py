@@ -167,33 +167,42 @@ def bench_new_cov_quality(rng, nexecs=16 * B):
     nbatch = nexecs // B
     call_ids, pc_idx, valid = make_workload(rng, nbatch=nbatch)
 
-    # CPU pipeline
-    t0 = time.perf_counter()
-    max_cover = [np.zeros(0, np.uint32) for _ in range(NCALLS)]
-    cpu_new = 0
-    for bi in range(nbatch):
-        for e in range(B):
-            cid = call_ids[bi, e]
-            cov = np.unique(pc_idx[bi, e][valid[bi, e]].astype(np.uint32))
-            diff = np.setdiff1d(cov, max_cover[cid], assume_unique=True)
-            if len(diff):
-                cpu_new += 1
-                max_cover[cid] = np.union1d(max_cover[cid], diff)
-    cpu_dt = time.perf_counter() - t0
+    # CPU pipeline (best of 3, like the device side)
+    cpu_dt = float("inf")
+    for _ in range(3):
+        t0 = time.perf_counter()
+        max_cover = [np.zeros(0, np.uint32) for _ in range(NCALLS)]
+        cpu_new = 0
+        for bi in range(nbatch):
+            for e in range(B):
+                cid = call_ids[bi, e]
+                cov = np.unique(pc_idx[bi, e][valid[bi, e]]
+                                .astype(np.uint32))
+                diff = np.setdiff1d(cov, max_cover[cid],
+                                    assume_unique=True)
+                if len(diff):
+                    cpu_new += 1
+                    max_cover[cid] = np.union1d(max_cover[cid], diff)
+        cpu_dt = min(cpu_dt, time.perf_counter() - t0)
 
     # device pipeline (same stream, same order).  Warm the jit on the
     # same engine, then zero the state — a fresh engine would recompile
-    # (jit caches on closure identity) inside the timed loop.
+    # (jit caches on closure identity) inside the timed loop.  Best of 3
+    # timed runs: the tunnel's host↔device bandwidth varies several-fold
+    # with shared-link congestion, and the metric is pipeline capability,
+    # not transient link weather (the CPU loop gets the same treatment).
     eng = CoverageEngine(npcs=NPCS, ncalls=NCALLS, corpus_cap=8,
                          batch=B, max_pcs_per_exec=K)
     import jax.numpy as jnp
     hn = eng.update_stream(call_ids, pc_idx, valid)      # warm compile
     np.asarray(hn)
-    eng.max_cover = jnp.zeros_like(eng.max_cover)
-    t0 = time.perf_counter()
-    hn = np.asarray(eng.update_stream(call_ids, pc_idx, valid))
-    dev_dt = time.perf_counter() - t0
-    dev_new = int(hn.sum())
+    dev_dt = float("inf")
+    for _ in range(3):
+        eng.max_cover = jnp.zeros_like(eng.max_cover)
+        t0 = time.perf_counter()
+        hn = np.asarray(eng.update_stream(call_ids, pc_idx, valid))
+        dev_dt = min(dev_dt, time.perf_counter() - t0)
+        dev_new = int(hn.sum())
     return {
         "new_cov_per_1k_exec_device": round(dev_new / (nexecs / 1000), 2),
         "new_cov_per_1k_exec_cpu": round(cpu_new / (nexecs / 1000), 2),
