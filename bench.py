@@ -20,8 +20,12 @@ Prints ONE JSON line:
    "extras": {...}}
 """
 
+import argparse
 import functools
 import json
+import os
+import subprocess
+import sys
 import time
 
 import numpy as np
@@ -37,12 +41,39 @@ NBATCH = 8          # distinct pre-generated batches, cycled
 SECONDS = 4.0
 
 
-def make_workload(rng, npcs=NPCS, nbatch=NBATCH, b=None):
+def _ensure_backend() -> str:
+    """Probe the default JAX backend in a SUBPROCESS (this process must
+    not import jax yet — a failed backend init is cached for the
+    process lifetime) and fall back to CPU when it cannot initialize,
+    so the bench always emits its JSON line instead of crashing with
+    `Unable to initialize backend` (BENCH_r05 rc=1)."""
+    r = subprocess.run(
+        [sys.executable, "-c", "import jax; jax.devices()"],
+        stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
+        timeout=120)
+    if r.returncode == 0:
+        return ""
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    sys.stderr.write("[bench] WARNING: default backend failed to "
+                     "initialize; falling back to JAX_PLATFORMS=cpu\n")
+    return "cpu-fallback"
+
+
+def _apply_smoke() -> None:
+    """Seconds-scale CPU-only config for presubmit: tiny shapes, same
+    code paths, same JSON schema."""
+    global NPCS, B, K, NBATCH, SECONDS
+    NPCS, B, K, NBATCH, SECONDS = 1 << 12, 64, 64, 2, 0.25
+
+
+def make_workload(rng, npcs=None, nbatch=None, b=None):
     """Steady-state-shaped coverage: each call has a hot PC region most
     execs stay inside (little new signal), with occasional outlier
     execs.  Rows are duplicate-free (strided arithmetic sequences with
     odd stride mod a power-of-two npcs), matching the executor's
     sort-deduped KCOV output — the engine's MXU pack relies on it."""
+    npcs = npcs or NPCS
+    nbatch = nbatch or NBATCH
     b = b or B
     call_ids = rng.integers(0, NCALLS, size=(nbatch, b)).astype(np.int32)
     hot_start = (call_ids.astype(np.int64) * 131) % npcs
@@ -273,21 +304,188 @@ def bench_corpus_scale(rng, C=100_000):
     }
 
 
+def bench_device_sparse(call_ids, pc_idx, valid, npcs, block_words=2,
+                        seconds=SECONDS, steps_per_call=64, chain=8):
+    """The word-block-sparse fused step on the same workload shape as
+    bench_device: per-batch touched blocks are precomputed host-side
+    (in production the engine does this per dispatch), the scan gathers
+    only those blocks, diffs/merges at the gathered width, and scatters
+    back.  Same harness discipline as bench_device: pre-uploaded cycled
+    batches, scalar scan outputs, value-fetch barriers every `chain`
+    calls."""
+    import jax
+    import jax.numpy as jnp
+
+    from syzkaller_tpu.cover.engine import (
+        nwords_for, sample_calls, sparse_update)
+
+    W = nwords_for(npcs)
+    nbatch, b = call_ids.shape
+    bits = block_words * 32
+    nblk = W // block_words
+    raw = []
+    for bi in range(nbatch):
+        ok = valid[bi] & (pc_idx[bi] >= 0) & (pc_idx[bi] < npcs)
+        raw.append(np.unique(pc_idx[bi][ok] // bits))
+    mb = max(len(r) for r in raw)
+    per = max(1, 64 // block_words)           # keep MB*block_words 64-aligned
+    mb = -(-mb // per) * per
+    blocks = np.full((nbatch, mb), nblk, np.int32)
+    for bi, r in enumerate(raw):
+        blocks[bi, : len(r)] = r
+
+    cis = jnp.asarray(call_ids)
+    pis = jnp.asarray(pc_idx)
+    vas = jnp.asarray(valid)
+    bls = jnp.asarray(blocks)
+
+    @functools.partial(jax.jit, donate_argnums=(0,))
+    def multi_step(max_cover, prios, enabled, key):
+        def body(carry, i):
+            mc, k = carry
+            bi = i % nbatch
+            ci = jax.lax.dynamic_index_in_dim(cis, bi, keepdims=False)
+            pi = jax.lax.dynamic_index_in_dim(pis, bi, keepdims=False)
+            va = jax.lax.dynamic_index_in_dim(vas, bi, keepdims=False)
+            bl = jax.lax.dynamic_index_in_dim(bls, bi, keepdims=False)
+            k, sub = jax.random.split(k)
+            mc, _new, has_new = sparse_update(mc, ci, pi, va, bl, npcs,
+                                              block_words)
+            nxt = sample_calls(sub, prios, ci, enabled)
+            return (mc, k), has_new.sum() + nxt[0]
+        (mc, k), outs = jax.lax.scan(body, (max_cover, key),
+                                     jnp.arange(steps_per_call))
+        return mc, k, outs.sum()
+
+    max_cover = jnp.zeros((NCALLS, W), jnp.uint32)
+    prios = jnp.full((NCALLS, NCALLS), 0.5, jnp.float32)
+    enabled = jnp.ones((NCALLS,), jnp.bool_)
+    key = jax.random.PRNGKey(0)
+    max_cover, key, out = multi_step(max_cover, prios, enabled, key)
+    int(out)                             # compile + warm, real barrier
+
+    calls = 0
+    t0 = time.perf_counter()
+    while time.perf_counter() - t0 < seconds:
+        max_cover, key, out = multi_step(max_cover, prios, enabled, key)
+        calls += 1
+        if calls % chain == 0:
+            int(out)                     # true completion of the chain
+    int(out)
+    dt = time.perf_counter() - t0
+    return b * steps_per_call * calls / dt
+
+
+def bench_admission(n_inputs=1536, nthreads=48, admit_batch=64, npcs=NPCS):
+    """Batched admission through the manager coalescer vs the old
+    serial per-input rpc_new_input path: N handler threads fire
+    distinct NewInputs (disjoint cover ranges, so the admitted set is
+    order-independent) at a live manager, once with admit_batch<=1
+    (serial: _admit_mu held across one device round-trip per input) and
+    once with the coalescer (fused batched dispatches).  Handlers are
+    invoked directly — the RPC socket layer is byte-identical for both
+    paths and exercised by the concurrent-admission test.
+
+    Cover ranges must stay inside the PcMap's direct index space
+    (n_inputs * 32 + warm < npcs - overflow_reserve): beyond it the
+    hashed-overflow region aliases distinct PCs, which makes admission
+    order-dependent and the serial-vs-coalesced set comparison
+    meaningless."""
+    import tempfile
+    import threading
+
+    from syzkaller_tpu import rpc as rpc_mod
+    from syzkaller_tpu.manager.config import Config
+    from syzkaller_tpu.manager.manager import Manager
+
+    def one_run(batch_size):
+        wd = tempfile.mkdtemp(prefix="syz-bench-adm-")
+        cfg = Config(workdir=wd, type="local", count=1, procs=1,
+                     descriptions="probe.txt", npcs=npcs, http="",
+                     corpus_cap=max(4 * n_inputs, 1 << 12),
+                     admit_batch=batch_size)
+        mgr = Manager(cfg)
+
+        def mk_payloads(base, per):
+            out = []
+            for t in range(nthreads):
+                ps = []
+                for i in range(per):
+                    j = base + t * per + i
+                    ps.append({"name": f"vm{t}",
+                               "prog": rpc_mod.b64(b"prog-%d" % j),
+                               "call": "mmap", "call_index": 0,
+                               "cover": [1000 + j * 64 + x
+                                         for x in range(32)]})
+                out.append(ps)
+            return out
+
+        def fire(ps):
+            for p in ps:
+                mgr.rpc_new_input(p)
+
+        def burst(payloads):
+            ts = [threading.Thread(target=fire, args=(payloads[t],))
+                  for t in range(nthreads)]
+            t0 = time.perf_counter()
+            for t in ts:
+                t.start()
+            for t in ts:
+                t.join()
+            return time.perf_counter() - t0
+
+        # warm with the same concurrency pattern as the timed run, so
+        # the steady-state pow2 dispatch buckets are compiled up front
+        n_warm = nthreads * 2
+        burst(mk_payloads(10_000_000, 2))
+        per = n_inputs // nthreads
+        dt = burst(mk_payloads(0, per))
+        admitted = len(mgr.corpus) - n_warm
+        mgr.stop()
+        return admitted, n_inputs / dt
+
+    serial_admitted, serial_rate = one_run(1)
+    coal_admitted, coal_rate = one_run(admit_batch)
+    assert serial_admitted == coal_admitted, \
+        f"admission sets diverge: {serial_admitted} vs {coal_admitted}"
+    return {
+        "admissions_per_sec": round(coal_rate, 1),
+        "admissions_per_sec_serial": round(serial_rate, 1),
+        "admission_speedup": round(coal_rate / serial_rate, 2),
+    }
+
+
 def _stage(name):
-    import sys
     sys.stderr.write(f"[bench] {name}\n")
     sys.stderr.flush()
 
 
-def main():
+def main(argv=None):
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--smoke", action="store_true",
+                    help="seconds-scale CPU-only shape/import smoke "
+                         "(presubmit gate), same code paths and JSON "
+                         "schema on tiny configs")
+    args = ap.parse_args(argv)
+
+    extras = {}
+    if args.smoke:
+        os.environ["JAX_PLATFORMS"] = "cpu"
+        _apply_smoke()
+        extras["config"] = "smoke"
+    else:
+        note = _ensure_backend()
+        if note:
+            extras["backend"] = note
+
     rng = np.random.default_rng(42)
     call_ids, pc_idx, valid = make_workload(rng)
     _stage("cpu baseline")
-    cpu_rate = bench_cpu(call_ids, pc_idx, valid)
+    cpu_rate = bench_cpu(call_ids, pc_idx, valid, seconds=SECONDS)
     _stage("device 64k")
-    dev_rate = bench_device(call_ids, pc_idx, valid)
+    dev_rate = bench_device(call_ids, pc_idx, valid, npcs=NPCS,
+                            seconds=SECONDS)
 
-    extras = {}
     # 1M-PC config (BASELINE config #5: "1M-PC sparse bitmap").  The
     # TPU-first architecture handles the sparse 1M-PC universe the way
     # production does (DeviceSignal): the vectorized PcMap hashes raw
@@ -296,27 +494,44 @@ def main():
     # runs at the dense width.  Per-exec device work is then
     # proportional to the live signal set, not the universe — the
     # "touch only what the workload references" sparse formulation.
+    big_npcs = 1 << (17 if not args.smoke else 13)
+    big_sec = 3.0 if not args.smoke else SECONDS
     _stage("device 1M-PC (observed-set, dense 128k)")
-    big = make_workload(np.random.default_rng(7), npcs=1 << 17,
-                        nbatch=4, b=2048)
+    big = make_workload(np.random.default_rng(7), npcs=big_npcs,
+                        nbatch=4, b=B)
     extras["updates_per_sec_1m_pc"] = round(
-        bench_device(*big, npcs=1 << 17, seconds=3.0), 1)
+        bench_device(*big, npcs=big_npcs, seconds=big_sec), 1)
     extras["updates_per_sec_1m_pc_config"] = (
         "observed-set: 1M-PC universe hashed to dense 128k live set "
         "(production DeviceSignal architecture); _dense_fullwidth is "
         "the r02-comparable raw 1M-wide config")
     # honesty extra: the raw dense-1M-wide step (no observed-set
     # mapping), bandwidth-bound on the 16×-wider bitmaps — this is the
-    # shape BENCH_r02's updates_per_sec_1m_pc measured
+    # shape BENCH_r02's updates_per_sec_1m_pc measured — and the
+    # word-block-sparse step on the SAME workload, which gathers only
+    # the blocks a batch touches so per-step work follows live signal
+    full_npcs = 1 << (20 if not args.smoke else 14)
+    full_b = 256 if not args.smoke else 32
     _stage("device 1M-PC (dense full-width)")
-    big = make_workload(np.random.default_rng(7), npcs=1 << 20,
-                        nbatch=4, b=256)
-    extras["updates_per_sec_1m_pc_dense_fullwidth"] = round(
-        bench_device(*big, npcs=1 << 20, seconds=3.0), 1)
+    big = make_workload(np.random.default_rng(7), npcs=full_npcs,
+                        nbatch=4, b=full_b)
+    dense_full = bench_device(*big, npcs=full_npcs, seconds=big_sec)
+    extras["updates_per_sec_1m_pc_dense_fullwidth"] = round(dense_full, 1)
+    _stage("device 1M-PC (word-block sparse)")
+    sparse_full = bench_device_sparse(*big, npcs=full_npcs,
+                                      seconds=big_sec)
+    extras["updates_per_sec_1m_pc_blocksparse"] = round(sparse_full, 1)
+    extras["blocksparse_speedup"] = round(sparse_full / dense_full, 2)
+    _stage("admission coalescer")
+    extras.update(bench_admission(
+        n_inputs=64 if args.smoke else 1536,
+        nthreads=4 if args.smoke else 48, npcs=NPCS))
     _stage("new-cov quality replay")
-    extras.update(bench_new_cov_quality(np.random.default_rng(11)))
+    extras.update(bench_new_cov_quality(np.random.default_rng(11),
+                                        nexecs=(2 if args.smoke else 16) * B))
     _stage("corpus scale")
-    extras.update(bench_corpus_scale(np.random.default_rng(13)))
+    extras.update(bench_corpus_scale(np.random.default_rng(13),
+                                     C=2048 if args.smoke else 100_000))
     _stage("done")
 
     print(json.dumps({

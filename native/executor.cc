@@ -747,6 +747,20 @@ struct Prog {
 static uint64_t results[kMaxCommands];
 static bool results_ready[kMaxCommands];
 
+// cross-thread result plumbing: worker threads publish retvals while the
+// main thread (collide mode) may concurrently resolve or reset them
+static void result_publish(uint64_t idx, uint64_t v)
+{
+	__atomic_store_n(&results[idx], v, __ATOMIC_RELAXED);
+	__atomic_store_n(&results_ready[idx], true, __ATOMIC_RELEASE);
+}
+
+static void results_reset()
+{
+	for (int i = 0; i < kMaxCommands; i++)
+		__atomic_store_n(&results_ready[i], false, __ATOMIC_RELAXED);
+}
+
 // ---------------------------------------------------------------------------
 // Thread pool (ref executor.cc:392-498). Worker threads execute one call at
 // a time; the main thread hands calls out round-robin and waits with a
@@ -788,10 +802,8 @@ static void write_output(Call* c, long retval, int err, uint32_t* cover,
 		__atomic_fetch_add(count, 1, __ATOMIC_SEQ_CST);
 	}
 	pthread_mutex_unlock(&output_mu);
-	if (c->result_idx != no_result) {
-		results[c->result_idx] = (uint64_t)retval;
-		results_ready[c->result_idx] = true;
-	}
+	if (c->result_idx != no_result)
+		result_publish(c->result_idx, (uint64_t)retval);
 }
 
 static uint64_t resolve_arg(uint64_t kind, uint64_t val, uint64_t ref,
@@ -799,7 +811,13 @@ static uint64_t resolve_arg(uint64_t kind, uint64_t val, uint64_t ref,
 {
 	if (kind == arg_const)
 		return val;
-	uint64_t v = results_ready[ref] ? results[ref] : (uint64_t)-1;
+	// acquire pairs with result_publish's release: racing threads in
+	// collide mode see either (-1) or the fully-written value, never a
+	// torn one (racy-VALUE semantics are intentional — ref racy
+	// copyout — racy UB is not)
+	uint64_t v = __atomic_load_n(&results_ready[ref], __ATOMIC_ACQUIRE)
+			 ? __atomic_load_n(&results[ref], __ATOMIC_RELAXED)
+			 : (uint64_t)-1;
 	if (divi)
 		v /= divi;
 	v += addi;
@@ -882,6 +900,18 @@ static void* worker_thread(void* arg)
 		pthread_cond_signal(&t->cv_done);
 	}
 	return NULL;
+}
+
+static bool thread_busy(Thread* t)
+{
+	// has_work is written under t->mu by both sides; the old unlocked
+	// read in execute_one's stuck-slot check was a formal data race —
+	// harmless on x86 in practice, but the status-report path must not
+	// depend on benign-race luck (flaky threaded+collide audit)
+	pthread_mutex_lock(&t->mu);
+	bool busy = t->has_work;
+	pthread_mutex_unlock(&t->mu);
+	return busy;
 }
 
 static bool thread_wait(Thread* t, int timeout_ms)
@@ -1075,8 +1105,7 @@ static void do_copyout(Copyout* co)
 	default:
 		NONFAILING(v = *(uint64_t*)addr);
 	}
-	results[co->result_idx] = v;
-	results_ready[co->result_idx] = true;
+	result_publish(co->result_idx, v);
 }
 
 // ---------------------------------------------------------------------------
@@ -1084,7 +1113,9 @@ static void do_copyout(Copyout* co)
 
 static void execute_one(Prog* p, bool collide)
 {
-	memset(results_ready, 0, sizeof(results_ready));
+	// atomic reset: a straggler thread from the previous pass may still
+	// be publishing its result concurrently
+	results_reset();
 	int ici = 0, ico = 0;
 	int next_thread = 0;
 	for (int i = 0; i < p->ncalls; i++) {
@@ -1094,7 +1125,7 @@ static void execute_one(Prog* p, bool collide)
 		if (flag_threaded) {
 			Thread* t = &threads[next_thread];
 			next_thread = (next_thread + 1) % kMaxThreads;
-			if (t->created && t->has_work && !thread_wait(t, 1000))
+			if (t->created && thread_busy(t) && !thread_wait(t, 1000))
 				continue; // thread stuck; skip its slot
 			thread_submit(t, p, c);
 			// collide mode: issue every 2nd call without waiting

@@ -257,6 +257,66 @@ def _seg_prefix_or_flat(cid_s: jax.Array, bm_s: jax.Array) -> jax.Array:
     return excl
 
 
+def touched_blocks(pc_idx: np.ndarray, valid: np.ndarray, npcs: int,
+                   block_words: int, max_blocks: int) -> "np.ndarray | None":
+    """Host side of the word-block-sparse step: the sorted unique block
+    ids a (B, K) index batch touches, padded with the sentinel NB (the
+    one-past-the-end block) to a fixed (max_blocks,) shape.  Returns
+    None when the batch touches more than max_blocks blocks — the
+    caller falls back to the dense full-width step, so sparseness is a
+    fast path, never a semantics change."""
+    bits = block_words * 32
+    nb = nwords_for(npcs) // block_words
+    ok = np.asarray(valid, bool) & (pc_idx >= 0) & (pc_idx < npcs)
+    blk = np.unique(np.asarray(pc_idx)[ok] // bits)
+    if len(blk) > max_blocks:
+        return None
+    out = np.full((max_blocks,), nb, np.int32)
+    out[: len(blk)] = blk
+    return out
+
+
+def sparse_update(max_cover: jax.Array, call_ids: jax.Array,
+                  pc_idx: jax.Array, valid: jax.Array, blocks: jax.Array,
+                  npcs: int, block_words: int
+                  ) -> tuple[jax.Array, jax.Array, jax.Array]:
+    """Word-block-sparse pack→diff→merge: gather only the word blocks
+    the batch touches, run the exact dense kernels at the (much
+    narrower) gathered width, and scatter the merged blocks back.
+    Per-step work then scales with the batch's live signal footprint
+    instead of the full bitmap width — the 1M-PC configs are
+    bandwidth-bound on exactly the full-width (B, W) passes this
+    removes.
+
+    `blocks` is the (MB,) sorted unique touched-block list from
+    `touched_blocks` (sentinel NB pads the tail); MB * block_words must
+    be 64-word aligned for pack_pcs's MXU factoring.  Semantics are
+    exactly `pack_pcs + diff_merge` at full width: the block-local
+    index remap is a bijection on touched blocks, untouched blocks
+    cannot gain or lose bits, and in-batch dedup sequencing is
+    unchanged.  Returns (merged max_cover, (B, MB*block_words)
+    block-local new bitmaps, (B,) has_new)."""
+    ncalls, W = max_cover.shape
+    NB = W // block_words
+    MB = blocks.shape[0]
+    bits = block_words * 32
+    # gather: clamp pad entries onto the last real block — their columns
+    # carry no valid indices, so they pass through diff_merge unchanged
+    # and the write-back drops them (sentinel NB, mode="drop")
+    gblk = jnp.minimum(blocks, NB - 1)
+    sub = max_cover.reshape(ncalls, NB, block_words)[:, gblk]
+    sub = sub.reshape(ncalls, MB * block_words)
+    blk = pc_idx // bits
+    pos = jnp.clip(jnp.searchsorted(blocks, blk), 0, MB - 1)
+    ok = valid & (pc_idx >= 0) & (pc_idx < npcs) & (blocks[pos] == blk)
+    local = pos * bits + pc_idx % bits
+    bitmaps = pack_pcs(local, ok, MB * bits, assume_unique=True)
+    merged_sub, new, has_new = diff_merge(sub, call_ids, bitmaps)
+    mc = max_cover.reshape(ncalls, NB, block_words).at[:, blocks].set(
+        merged_sub.reshape(ncalls, MB, block_words), mode="drop")
+    return mc.reshape(ncalls, W), new, has_new
+
+
 def popcount_rows(mat: jax.Array) -> jax.Array:
     return jax.lax.population_count(mat).sum(axis=-1, dtype=jnp.int32)
 
@@ -397,6 +457,14 @@ class UpdateResult:
     bitmaps: jax.Array      # (B, W) device-resident full exec bitmaps
 
 
+@dataclass
+class SparseUpdateResult:
+    has_new: jax.Array          # (B,) device bool — fetch with np.asarray
+    new_bits: jax.Array         # (B, MB*block_words) block-LOCAL diffs,
+    #                             or full-width on the dense fallback
+    blocks: "np.ndarray | None"  # (MB,) touched block ids; None = dense
+
+
 class CoverageEngine:
     """Device-resident fuzzing state (SURVEY §7 architecture stance).
 
@@ -407,7 +475,8 @@ class CoverageEngine:
 
     def __init__(self, npcs: int, ncalls: int, corpus_cap: int = 4096,
                  batch: int = 64, max_pcs_per_exec: int = 512,
-                 mesh: "Mesh | None" = None, seed: int = 0):
+                 mesh: "Mesh | None" = None, seed: int = 0,
+                 block_words: int = 2, max_touched_blocks: int = 0):
         self.npcs = npcs
         self.ncalls = ncalls
         self.W = nwords_for(npcs)
@@ -415,6 +484,19 @@ class CoverageEngine:
         self.batch = batch
         self.K = max_pcs_per_exec
         self.mesh = mesh
+        # word-block-sparse config: 0 max_touched_blocks disables the
+        # sparse fast path (update_batch_sparse degrades to the dense
+        # step).  MB * block_words must stay 64-word aligned for
+        # pack_pcs's MXU factoring, so round MB up.
+        self.block_words = block_words
+        if max_touched_blocks > 0:
+            per = max(1, 64 // block_words)
+            max_touched_blocks = -(-max_touched_blocks // per) * per
+            if self.W % block_words:
+                max_touched_blocks = 0      # bitmap not block-divisible
+            elif max_touched_blocks * block_words >= self.W:
+                max_touched_blocks = 0      # sparse wouldn't be narrower
+        self.max_touched_blocks = max_touched_blocks
         self.key = jax.random.PRNGKey(seed)
         self._key_mu = threading.Lock()
         self._state_mu = threading.RLock()
@@ -467,6 +549,11 @@ class CoverageEngine:
         def _or_rows(base, call_ids, bitmaps):
             return scatter_or(base, call_ids, bitmaps)
 
+        @functools.partial(jax.jit, donate_argnums=(0,))
+        def _update_sparse(max_cover, call_ids, pc_idx, valid, blocks):
+            return sparse_update(max_cover, call_ids, pc_idx, valid,
+                                 blocks, npcs, self.block_words)
+
         @functools.partial(jax.jit, donate_argnums=(0, 1))
         def _admit_if_new(corpus_cover, corpus_mat, flakes, call_ids,
                           pc_idx, valid, start):
@@ -485,6 +572,26 @@ class CoverageEngine:
             idx = jnp.where(has_new, idx, corpus_mat.shape[0])
             mat = corpus_mat.at[idx].set(bitmaps, mode="drop")
             return cover, mat, has_new
+
+        @functools.partial(jax.jit, donate_argnums=(0, 1))
+        def _admit_if_new_choices(corpus_cover, corpus_mat, flakes,
+                                  call_ids, pc_idx, valid, start, key,
+                                  prios, enabled, prev):
+            """The coalescer's fused step: the batched admission gate +
+            merge PLUS a batch of ChoiceTable draws in the SAME
+            dispatch, so Poll responses are fed from a pre-drawn ring
+            instead of paying a separate sample_next_calls round trip
+            per poll."""
+            bitmaps = pack_pcs(pc_idx, valid, npcs, assume_unique=True)
+            gate = jnp.bitwise_or(corpus_cover, flakes)
+            _g, _new, has_new = diff_merge(gate, call_ids, bitmaps)
+            rows = jnp.where(has_new[:, None], bitmaps, jnp.uint32(0))
+            cover = scatter_or(corpus_cover, call_ids, rows)
+            idx = jnp.cumsum(has_new.astype(jnp.int32)) - 1 + start
+            idx = jnp.where(has_new, idx, corpus_mat.shape[0])
+            mat = corpus_mat.at[idx].set(bitmaps, mode="drop")
+            draws = sample_calls(key, prios, prev, enabled)
+            return cover, mat, has_new, draws
 
         @jax.jit
         def _diff_vs(base, call_ids, pc_idx, valid, flakes):
@@ -622,7 +729,9 @@ class CoverageEngine:
         self._update_stream32_fn = _update_stream32
         self._admit_selected_fn = _admit_selected
         self._update_fn = _update
+        self._update_sparse_fn = _update_sparse
         self._admit_if_new_fn = _admit_if_new
+        self._admit_choices_fn = _admit_if_new_choices
         self._or_rows_fn = _or_rows
         self._diff_vs_fn = _diff_vs
         self._admit_fn = _admit
@@ -661,6 +770,37 @@ class CoverageEngine:
         res = self.update_batch_async(call_ids, pc_idx, valid)
         return UpdateResult(has_new=np.asarray(res.has_new),
                             new_bits=res.new_bits, bitmaps=res.bitmaps)
+
+    @_locked
+    def update_batch_sparse(self, call_ids, pc_idx, valid
+                            ) -> SparseUpdateResult:
+        """The hot step at word-block granularity: gather only the
+        blocks this batch touches, diff/merge at the gathered width,
+        scatter back — per-step cost scales with the batch's signal
+        footprint, not the bitmap width (the 1M-PC gap).  Falls back to
+        the dense full-width step when sparse is disabled, the batch
+        touches more than max_touched_blocks blocks, or the engine is
+        sharded (the block gather would cross the PC-axis shards).
+        Verdicts and the merged max cover are bit-identical either way.
+        No host sync: has_new is a device array the caller fetches."""
+        pc_idx = np.asarray(pc_idx)
+        valid = np.asarray(valid)
+        blocks = None
+        if self.max_touched_blocks and self.mesh is None:
+            blocks = touched_blocks(pc_idx, valid, self.npcs,
+                                    self.block_words,
+                                    self.max_touched_blocks)
+        if blocks is None:
+            cs, ps, vs = self._fit(call_ids, pc_idx, valid)
+            self.max_cover, new, has_new, _bm = self._update_fn(
+                self.max_cover, cs, ps, vs)
+            return SparseUpdateResult(has_new=has_new, new_bits=new,
+                                      blocks=None)
+        cs, ps, vs = self._fit(call_ids, pc_idx, valid)
+        self.max_cover, new, has_new = self._update_sparse_fn(
+            self.max_cover, cs, ps, vs, jnp.asarray(blocks))
+        return SparseUpdateResult(has_new=has_new, new_bits=new,
+                                  blocks=blocks)
 
     @_locked
     def update_stream(self, call_ids, pc_idx, valid):
@@ -746,21 +886,49 @@ class CoverageEngine:
         which case NOTHING merges (manager drop-the-input semantics).
         The capacity check is conservative — the whole batch must fit,
         since the admitted count is only known after the dispatch."""
+        has_new, rows, _ch = self._admit_locked(call_ids, pc_idx, valid,
+                                                None)
+        return has_new, rows
+
+    @_locked
+    def admit_batch(self, call_ids, pc_idx, valid, choice_prev
+                    ) -> "tuple[np.ndarray, np.ndarray | None, np.ndarray]":
+        """admit_if_new fused with a batch of ChoiceTable draws in the
+        SAME device dispatch (the coalescer's step): returns (has_new,
+        rows, choices) where choices is (len(choice_prev),) next-call
+        ids drawn from the priority matrix."""
+        return self._admit_locked(call_ids, pc_idx, valid,
+                                  np.asarray(choice_prev, np.int32))
+
+    def _admit_locked(self, call_ids, pc_idx, valid, choice_prev):
         call_ids, pc_idx, valid = self._fit(call_ids, pc_idx, valid)
         n_in = int(call_ids.shape[0])
         if self.corpus_len + n_in > self.cap:
             new, has_new, _bm = self._diff_vs_fn(
                 self.corpus_cover, call_ids, pc_idx, valid, self.flakes)
-            return np.asarray(has_new), None
-        self.corpus_cover, self.corpus_mat, has_new = self._admit_if_new_fn(
-            self.corpus_cover, self.corpus_mat, self.flakes, call_ids,
-            pc_idx, valid, jnp.int32(self.corpus_len))
+            choices = (self.sample_next_calls(choice_prev)
+                       if choice_prev is not None else None)
+            return np.asarray(has_new), None, choices
+        if choice_prev is None:
+            self.corpus_cover, self.corpus_mat, has_new = \
+                self._admit_if_new_fn(
+                    self.corpus_cover, self.corpus_mat, self.flakes,
+                    call_ids, pc_idx, valid, jnp.int32(self.corpus_len))
+            choices = None
+        else:
+            self.corpus_cover, self.corpus_mat, has_new, choices = \
+                self._admit_choices_fn(
+                    self.corpus_cover, self.corpus_mat, self.flakes,
+                    call_ids, pc_idx, valid, jnp.int32(self.corpus_len),
+                    self._next_key(), self.prios, self.enabled,
+                    jnp.asarray(choice_prev, jnp.int32))
+            choices = np.asarray(choices)
         has_new = np.asarray(has_new)
         admitted = np.nonzero(has_new)[0]
         rows = np.arange(self.corpus_len, self.corpus_len + len(admitted))
         self.corpus_call[rows] = np.asarray(call_ids)[admitted]
         self.corpus_len += len(admitted)
-        return has_new, rows
+        return has_new, rows, choices
 
     @_locked
     def triage_diff(self, call_ids, pc_idx, valid):

@@ -44,9 +44,15 @@ class DeviceSignal:
                  corpus_cap: int = 1 << 14, seed: int = 0):
         from syzkaller_tpu.cover.engine import CoverageEngine
 
+        # wide bitmaps (≥128k PCs) get the word-block-sparse hot step:
+        # per-batch device work follows the signal footprint instead of
+        # the full width; narrow bitmaps keep the plain dense step
+        # (the sparse gather/scatter wouldn't pay for itself)
+        sparse_blocks = 512 if npcs >= (1 << 17) else 0
         self.engine = CoverageEngine(
             npcs=npcs, ncalls=ncalls, corpus_cap=corpus_cap,
-            batch=flush_batch, max_pcs_per_exec=max_pcs, seed=seed)
+            batch=flush_batch, max_pcs_per_exec=max_pcs, seed=seed,
+            max_touched_blocks=sparse_blocks)
         self.pcmap = PcMap(npcs)
         self.B = flush_batch
         self.K = max_pcs
@@ -80,7 +86,9 @@ class DeviceSignal:
         call_ids = np.zeros((idx.shape[0],), np.int32)
         m = owner >= 0
         call_ids[m] = np.array([entries[o][0] for o in owner[m]], np.int32)
-        res = self.engine.update_batch_async(call_ids, idx, valid)
+        # sparse when configured and the batch's footprint fits; the
+        # engine falls back to the dense step with identical verdicts
+        res = self.engine.update_batch_sparse(call_ids, idx, valid)
         return (res, owner, len(entries))
 
     def resolve(self, ticket) -> np.ndarray:

@@ -1,0 +1,239 @@
+"""Manager-side admission coalescer: batched, pipelined NewInput.
+
+The serial admission path holds the manager's admission lock across one
+host↔device round-trip PER input (`rpc_new_input`), which serializes the
+whole fleet's admission plane — the same fixed-dispatch-cost economics
+AFL-style fuzzers and batched-inference servers both exploit.  Here
+concurrent `Manager.NewInput` RPC handler threads enqueue into an
+admission queue and block on a per-input ticket (the submit/resolve
+pattern of fuzzer/device_signal.py); a drainer thread aggregates up to
+`max_batch` pending inputs, maps them through the vectorized PcMap in
+ONE call, and issues ONE fused device dispatch that (a) runs the
+dedup-safe diff-vs-corpus gate for the whole batch — sequenced
+on-device in submission order, so the serial path's TOCTOU guarantee
+(two concurrent duplicates admit exactly once) is preserved exactly —
+(b) merges admitted rows into the corpus matrix, and (c) draws a batch
+of ChoiceTable decisions into a pre-drawn ring that feeds Poll
+responses without their own `sample_next_calls` dispatch.
+
+The wire protocol and admission semantics are byte-identical to the
+serial path: callers see the same empty reply, duplicates and
+no-new-signal inputs count as "rejected inputs", admitted inputs
+broadcast to the other fuzzers and persist to disk.
+"""
+
+from __future__ import annotations
+
+import threading
+from collections import deque
+from dataclasses import dataclass, field
+
+import numpy as np
+
+from syzkaller_tpu.utils import log
+
+
+@dataclass
+class _Pending:
+    name: str
+    sig: bytes
+    data: bytes
+    call: str
+    call_index: int
+    call_id: int
+    cover: np.ndarray
+    wire_prog: str
+    wire_cover: list
+    done: threading.Event = field(default_factory=threading.Event)
+    result: dict = field(default_factory=dict)
+
+
+class AdmissionCoalescer:
+    """Batches concurrent NewInput admissions into fused device steps."""
+
+    # PC cap per admission cover (matches the serial path's map_batch K)
+    K = 256
+    # smallest padded shapes: dispatch shapes are pow2-bucketed so the
+    # compiled-shape set stays O(log^2) while small batches don't pay
+    # full-batch kernel cost (on CPU-class backends per-row work, not
+    # dispatch overhead, dominates)
+    MIN_B, MIN_K = 8, 32
+
+    def __init__(self, manager, max_batch: int = 64,
+                 choices_per_step: int = 256, choice_ring_cap: int = 4096,
+                 gather_ms: float = 1.0):
+        self.mgr = manager
+        self.max_batch = max_batch
+        self.choices_per_step = choices_per_step
+        self.choice_ring_cap = choice_ring_cap
+        self.gather_ms = gather_ms
+        self._q: deque[_Pending] = deque()
+        self._cv = threading.Condition()
+        self._stop = False
+        self._choices: deque[int] = deque()
+        self._choice_mu = threading.Lock()
+        self.stat_batches = 0
+        self.stat_coalesced = 0          # inputs that shared a dispatch
+        self._thread = threading.Thread(target=self._drain_loop,
+                                        name="admission-coalescer",
+                                        daemon=True)
+        self._thread.start()
+
+    # -- RPC-handler side --------------------------------------------------
+
+    def submit(self, name: str, sig: bytes, data: bytes, call: str,
+               call_index: int, call_id: int, cover: np.ndarray,
+               wire_prog: str, wire_cover: list) -> dict:
+        """Enqueue one admission and block until its batch resolves.
+        Called from many RPC handler threads concurrently."""
+        p = _Pending(name=name, sig=sig, data=data, call=call,
+                     call_index=call_index, call_id=call_id, cover=cover,
+                     wire_prog=wire_prog, wire_cover=wire_cover)
+        with self._cv:
+            if self._stop:
+                return {}
+            self._q.append(p)
+            self._cv.notify()
+        p.done.wait()
+        return p.result
+
+    def pop_choices(self, n: int) -> list[int]:
+        """Up to n pre-drawn ChoiceTable decisions (may return fewer —
+        the caller tops up via the direct sampling path)."""
+        out = []
+        with self._choice_mu:
+            while self._choices and len(out) < n:
+                out.append(self._choices.popleft())
+        return out
+
+    def stop(self) -> None:
+        with self._cv:
+            self._stop = True
+            self._cv.notify_all()
+        self._thread.join(timeout=10.0)
+        # unblock anyone still waiting (their entries were drained or
+        # the drainer exited before reaching them)
+        with self._cv:
+            while self._q:
+                self._q.popleft().done.set()
+
+    # -- drainer -----------------------------------------------------------
+
+    def _drain_loop(self) -> None:
+        import time
+
+        while True:
+            with self._cv:
+                while not self._q and not self._stop:
+                    self._cv.wait(timeout=0.5)
+                if self._stop and not self._q:
+                    return
+                # adaptive gather window: concurrent submitters land in
+                # ONE fused dispatch instead of a trickle of partial
+                # ones.  Wait in short slices only while the queue is
+                # still GROWING (a resolved batch's callers resubmit
+                # within a few hundred µs) and stop as soon as it
+                # plateaus — a fixed window would over-wait every cycle.
+                # gather_ms caps the total; ~1ms is noise next to an
+                # admission round trip.
+                deadline = time.monotonic() + self.gather_ms / 1000.0
+                prev_len = len(self._q)
+                while (len(self._q) < self.max_batch and not self._stop):
+                    left = deadline - time.monotonic()
+                    if left <= 0:
+                        break
+                    self._cv.wait(timeout=min(left, 0.00025))
+                    if len(self._q) == prev_len:
+                        break                      # plateaued
+                    prev_len = len(self._q)
+                batch = [self._q.popleft()
+                         for _ in range(min(len(self._q), self.max_batch))]
+            try:
+                self._process(batch)
+            except Exception as e:  # resolve tickets even on engine bugs
+                log.logf(0, "admission batch failed: %s", e)
+            finally:
+                for p in batch:
+                    p.done.set()
+
+    def _process(self, batch: list[_Pending]) -> None:
+        mgr = self.mgr
+        if len(batch) > 1:
+            self.stat_coalesced += len(batch)
+        self.stat_batches += 1
+        with mgr._admit_mu:
+            # host-side dedup FIRST (same early-out as the serial path):
+            # already-in-corpus or repeated-in-batch sigs resolve to the
+            # empty reply without touching the device
+            fresh: list[_Pending] = []
+            with mgr._mu:
+                seen: set[bytes] = set()
+                for p in batch:
+                    if p.sig in mgr.corpus or p.sig in seen:
+                        continue
+                    seen.add(p.sig)
+                    fresh.append(p)
+            if not fresh:
+                return
+            # ONE vectorized sparse→dense mapping for the whole batch,
+            # padded to pow2-bucketed dispatch shapes: arbitrary shapes
+            # would recompile per batch, while always padding to
+            # (max_batch, K) would make every small batch pay the full
+            # batch's kernel cost — per-step work should follow the
+            # batch's live size instead
+            n = len(fresh)
+            kb = self.MIN_K
+            maxlen = max(min(len(p.cover), self.K) for p in fresh)
+            while kb < maxlen:
+                kb *= 2
+            kb = min(kb, self.K)
+            idx, valid = mgr.pcmap.map_batch([p.cover for p in fresh],
+                                             K=kb)
+            B = self.MIN_B
+            while B < n:
+                B *= 2
+            B = min(B, self.max_batch)
+            call_ids = np.zeros((B,), np.int32)
+            pidx = np.zeros((B, kb), np.int32)
+            pval = np.zeros((B, kb), bool)
+            call_ids[:n] = [p.call_id for p in fresh]
+            pidx[:n] = idx
+            pval[:n] = valid
+            prev = np.full((self.choices_per_step,), -1, np.int32)
+            has_new, rows, choices = mgr.engine.admit_batch(
+                call_ids, pidx, pval, choice_prev=prev)
+            self._refill_choices(choices)
+            admitted: list[tuple[_Pending, int]] = []
+            cursor = 0
+            with mgr._mu:
+                for j, p in enumerate(fresh):
+                    if not has_new[j]:
+                        mgr.stats["rejected inputs"] = \
+                            mgr.stats.get("rejected inputs", 0) + 1
+                        continue
+                    # rows[k] is the corpus row of the k-th admitted
+                    # entry in submission order (None: matrix full,
+                    # nothing merged — the serial path records -1 too)
+                    row = int(rows[cursor]) if rows is not None else -1
+                    cursor += 1
+                    mgr._record_admitted(p, row)
+                    admitted.append((p, row))
+        # resolve tickets BEFORE persistence: callers resubmit their
+        # next input while the drainer writes this batch's programs to
+        # disk (persistence stays ordered inside the drainer, lag
+        # bounded by one batch — the reply itself was never transactional
+        # with the disk write)
+        for p in batch:
+            p.done.set()
+        for p, _row in admitted:
+            mgr.persistent.add(p.data)
+        if admitted:
+            mgr._maybe_update_prios()
+
+    def _refill_choices(self, choices) -> None:
+        if choices is None:
+            return
+        with self._choice_mu:
+            room = self.choice_ring_cap - len(self._choices)
+            for c in np.asarray(choices)[:room]:
+                self._choices.append(int(c))

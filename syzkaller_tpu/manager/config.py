@@ -43,6 +43,8 @@ class Config:
     npcs: int = 1 << 16                # coverage bitmap size (PC axis)
     corpus_cap: int = 1 << 14
     flush_batch: int = 256
+    admit_batch: int = 64              # NewInput coalescer batch size
+    #                                    (<=1 = serial per-input admission)
     fuzzer_device: bool = False        # fuzzers run signal diffs on device
     mesh: int = 0                      # shard the PC axis over N devices
     #                                    (0/1 = single-device engine;
@@ -116,6 +118,9 @@ class Config:
             raise ConfigError("lkvm requires kernel")
         if self.mesh < 0:
             raise ConfigError(f"invalid mesh {self.mesh}")
+        if not 0 <= self.admit_batch <= 4096:
+            raise ConfigError(
+                f"invalid admit_batch {self.admit_batch} (0..4096)")
         # NOTE: device availability for `mesh` is checked when the
         # manager builds the engine (cover.engine.pc_mesh raises) —
         # config linting must not initialize an accelerator runtime.

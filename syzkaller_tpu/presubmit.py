@@ -68,10 +68,27 @@ def main(argv=None) -> int:
         if r.returncode != 0:
             raise SystemExit("engine smoke failed")
 
+    def bench_smoke():
+        # seconds-scale CPU-only bench pass on tiny shapes: catches
+        # bench.py import/shape regressions here instead of in the next
+        # full bench round (which historically surfaced them as rc=1)
+        import json
+
+        r = subprocess.run(
+            [sys.executable, "bench.py", "--smoke"],
+            cwd=root, env=env, capture_output=True, text=True)
+        if r.returncode != 0:
+            sys.stderr.write(r.stderr[-2000:])
+            raise SystemExit(f"bench smoke failed ({r.returncode})")
+        line = r.stdout.strip().splitlines()[-1]
+        out = json.loads(line)            # the JSON line must parse
+        assert out["metric"] and out["extras"], out
+
     total = 0.0
     total += step("description tables", gen_tables)
     total += step("native executor build", build_executor)
     total += step("engine + multichip smoke", engine_smoke)
+    total += step("bench smoke", bench_smoke)
     total += step("pytest", pytest_run)
     print(f"[presubmit] PASS in {total:.0f}s")
     return 0

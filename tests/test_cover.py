@@ -308,6 +308,103 @@ def test_sample_corpus_rows(rng):
     assert (rows == 0).sum() > (rows == 1).sum()
 
 
+# the sparse tests want a bitmap wide enough that a gathered sub-width
+# is actually narrower (module NPCS is only 128 words)
+SP_NPCS = 1 << 14
+
+
+def _clustered_covers(rng, n, span=40, outliers=3, npcs=SP_NPCS):
+    """Hot-range covers + a few outliers — the shape the word-block
+    sparse step is built for (most batches touch few blocks)."""
+    out = []
+    for _ in range(n):
+        start = int(rng.integers(0, npcs - span - 1))
+        c = np.concatenate([start + np.arange(span),
+                            rng.integers(0, npcs, outliers)])
+        out.append(sets.canonicalize(c))
+    return out
+
+
+def test_sparse_update_matches_dense(rng):
+    """The word-block-sparse step must be bit-identical to the dense
+    full-width step: same has_new verdicts, same merged max cover,
+    across batches that do and don't trigger the sparse gather."""
+    e_dense = CoverageEngine(npcs=SP_NPCS, ncalls=NCALLS, corpus_cap=64)
+    e_sparse = CoverageEngine(npcs=SP_NPCS, ncalls=NCALLS, corpus_cap=64,
+                              block_words=2, max_touched_blocks=64)
+    assert e_sparse.max_touched_blocks > 0
+    sparse_used = 0
+    for it in range(6):
+        covers = _clustered_covers(rng, 8)
+        calls = rng.integers(0, NCALLS, size=8).astype(np.int32)
+        idx, valid = make_batch(covers)
+        rd = e_dense.update_batch(calls, idx, valid)
+        rs = e_sparse.update_batch_sparse(calls, idx, valid)
+        sparse_used += rs.blocks is not None
+        assert (np.asarray(rs.has_new) == rd.has_new).all(), it
+        assert (np.asarray(e_sparse.max_cover)
+                == np.asarray(e_dense.max_cover)).all(), it
+    assert sparse_used >= 4, "workload never exercised the sparse path"
+    # identical resend: no new signal through the sparse path either
+    rs = e_sparse.update_batch_sparse(calls, idx, valid)
+    assert not np.asarray(rs.has_new).any()
+
+
+def test_sparse_update_overflow_falls_back_dense(rng):
+    """A batch touching more blocks than max_touched_blocks must fall
+    back to the dense step (blocks=None) with identical verdicts —
+    sparseness is a fast path, never a semantics change."""
+    eng = CoverageEngine(npcs=SP_NPCS, ncalls=4, corpus_cap=8,
+                         block_words=2, max_touched_blocks=32)
+    covers = [sets.canonicalize(rng.integers(0, SP_NPCS, 120))
+              for _ in range(4)]                       # wide spray
+    idx, valid = make_batch(covers, K=256)
+    res = eng.update_batch_sparse(np.zeros(4, np.int32), idx, valid)
+    assert res.blocks is None
+    assert np.asarray(res.has_new).all()
+    union = set(np.concatenate(covers).tolist())
+    assert set(eng.max_cover_pcs(0).tolist()) == union
+
+
+def test_sparse_config_rejects_unhelpful_shapes():
+    """Sparse config disables itself when the bitmap is too narrow for
+    the gathered width to be narrower, instead of dispatching a
+    degenerate gather."""
+    eng = CoverageEngine(npcs=1 << 10, ncalls=4, corpus_cap=8,
+                         block_words=2, max_touched_blocks=4096)
+    assert eng.max_touched_blocks == 0
+
+
+def test_admit_batch_fused_choices(rng):
+    """admit_batch = admit_if_new + a batch of ChoiceTable draws in one
+    dispatch: same admission verdicts/rows as the unfused path, plus
+    valid enabled draws."""
+    eng = CoverageEngine(npcs=SP_NPCS, ncalls=8, corpus_cap=64)
+    eng.set_enabled([1, 3, 5])
+    covers = _clustered_covers(rng, 4)
+    calls = np.array([1, 1, 3, 5], np.int32)
+    idx, valid = make_batch(covers)
+    prev = np.full((32,), -1, np.int32)
+    has_new, rows, choices = eng.admit_batch(calls, idx, valid, prev)
+    assert has_new.all()
+    assert list(rows) == [0, 1, 2, 3]
+    assert choices.shape == (32,)
+    assert set(np.unique(choices).tolist()) <= {1, 3, 5}
+    # an already-admitted cover (same call) is rejected
+    idx2, valid2 = make_batch([covers[0], covers[0]])
+    has_new, rows, choices = eng.admit_batch(
+        np.array([1, 1], np.int32), idx2, valid2, prev)
+    assert not has_new.any()
+    # in-batch duplicate pair: first admits, second rejected (the
+    # on-device sequencing that preserves the serial TOCTOU gate)
+    fresh = sets.canonicalize(np.arange(3000, 3050, dtype=np.uint32))
+    idx3, valid3 = make_batch([fresh, fresh])
+    has_new, rows, choices = eng.admit_batch(
+        np.array([5, 5], np.int32), idx3, valid3, prev)
+    assert has_new[0] and not has_new[1]
+    assert len(rows) == 1
+
+
 def test_profiler_capture(tmp_path, engine, rng):
     """JAX profiler hook: a capture window around live engine work
     produces a tensorboard-loadable trace (SURVEY §5 step profiling)."""
