@@ -476,8 +476,15 @@ class CoverageEngine:
     def __init__(self, npcs: int, ncalls: int, corpus_cap: int = 4096,
                  batch: int = 64, max_pcs_per_exec: int = 512,
                  mesh: "Mesh | None" = None, seed: int = 0,
-                 block_words: int = 2, max_touched_blocks: int = 0):
+                 block_words: int = 2, max_touched_blocks: int = 0,
+                 telemetry=None):
         self.npcs = npcs
+        # telemetry: a telemetry.device.DeviceStats whose fixed-slot
+        # int32 vector the fused dispatches bump in place (.at[].add
+        # inside the jit) — hot-loop counting without extra round trips.
+        # None disables instrumentation entirely (the bumps are not
+        # traced at all, so the disabled path compiles unchanged).
+        self.tstats = telemetry
         self.ncalls = ncalls
         self.W = nwords_for(npcs)
         self.cap = corpus_cap
@@ -510,6 +517,9 @@ class CoverageEngine:
         self.corpus_len = 0
         self.prios = jnp.full((ncalls, ncalls), 1.0, jnp.float32)
         self.enabled = jnp.ones((ncalls,), jnp.bool_)
+        # dummy stat-vector operands for the telemetry-disabled mode:
+        # the jitted steps keep one signature either way
+        self._ts_dummy = jnp.zeros((1,), jnp.int32)
 
         if mesh is not None:
             self.shard(mesh)
@@ -531,32 +541,62 @@ class CoverageEngine:
         self.corpus_mat = jax.device_put(self.corpus_mat, row)
         self.prios = jax.device_put(self.prios, rep)
         self.enabled = jax.device_put(self.enabled, rep)
+        self._ts_dummy = jax.device_put(self._ts_dummy, rep)
+        if self.tstats is not None:
+            self.tstats.device_put(mesh)
         self._build()
 
     # -- jit closures ----------------------------------------------------
 
     def _build(self) -> None:
         npcs = self.npcs
+        ds = self.tstats
+
+        def _bump(svec, hinc, batch_slot, rows_slot, new_slot,
+                  valid, has_new, extra=()):
+            """Fold the ride-along host increments and this dispatch's
+            own counts into the stat vector — INSIDE the jit, so
+            telemetry costs a few scalar adds on a tiny replicated
+            vector, never a transfer of its own.  Traced only when
+            telemetry is enabled (ds closure)."""
+            svec = svec + hinc
+            svec = svec.at[ds.slot(batch_slot)].add(1)
+            svec = svec.at[ds.slot(rows_slot)].add(
+                jnp.sum(valid.any(axis=-1), dtype=jnp.int32))
+            svec = svec.at[ds.slot(new_slot)].add(
+                jnp.sum(has_new, dtype=jnp.int32))
+            for slot, n in extra:
+                svec = svec.at[ds.slot(slot)].add(jnp.int32(n))
+            return svec
 
         @functools.partial(jax.jit, donate_argnums=(0,))
-        def _update(max_cover, call_ids, pc_idx, valid):
+        def _update(max_cover, call_ids, pc_idx, valid, svec, hinc):
             # PcMap.map_batch guarantees unique indices per row
             bitmaps = pack_pcs(pc_idx, valid, npcs, assume_unique=True)
             merged, new, has_new = diff_merge(max_cover, call_ids, bitmaps)
-            return merged, new, has_new, bitmaps
+            if ds is not None:
+                svec = _bump(svec, hinc, "dense_batches", "dense_rows",
+                             "dense_newsig", valid, has_new)
+            return merged, new, has_new, bitmaps, svec
 
         @functools.partial(jax.jit, donate_argnums=(0,))
         def _or_rows(base, call_ids, bitmaps):
             return scatter_or(base, call_ids, bitmaps)
 
         @functools.partial(jax.jit, donate_argnums=(0,))
-        def _update_sparse(max_cover, call_ids, pc_idx, valid, blocks):
-            return sparse_update(max_cover, call_ids, pc_idx, valid,
-                                 blocks, npcs, self.block_words)
+        def _update_sparse(max_cover, call_ids, pc_idx, valid, blocks,
+                          svec, hinc):
+            merged, new, has_new = sparse_update(
+                max_cover, call_ids, pc_idx, valid, blocks, npcs,
+                self.block_words)
+            if ds is not None:
+                svec = _bump(svec, hinc, "sparse_batches", "sparse_rows",
+                             "sparse_newsig", valid, has_new)
+            return merged, new, has_new, svec
 
         @functools.partial(jax.jit, donate_argnums=(0, 1))
         def _admit_if_new(corpus_cover, corpus_mat, flakes, call_ids,
-                          pc_idx, valid, start):
+                          pc_idx, valid, start, svec, hinc):
             """Fused admission gate + merge in ONE dispatch: the manager
             used to pay two tunnel round-trips per NewInput (diff, then
             merge) while holding its admission lock.  In-batch
@@ -571,12 +611,15 @@ class CoverageEngine:
             idx = jnp.cumsum(has_new.astype(jnp.int32)) - 1 + start
             idx = jnp.where(has_new, idx, corpus_mat.shape[0])
             mat = corpus_mat.at[idx].set(bitmaps, mode="drop")
-            return cover, mat, has_new
+            if ds is not None:
+                svec = _bump(svec, hinc, "admit_batches", "admit_inputs",
+                             "admit_admitted", valid, has_new)
+            return cover, mat, has_new, svec
 
         @functools.partial(jax.jit, donate_argnums=(0, 1))
         def _admit_if_new_choices(corpus_cover, corpus_mat, flakes,
                                   call_ids, pc_idx, valid, start, key,
-                                  prios, enabled, prev):
+                                  prios, enabled, prev, svec, hinc):
             """The coalescer's fused step: the batched admission gate +
             merge PLUS a batch of ChoiceTable draws in the SAME
             dispatch, so Poll responses are fed from a pre-drawn ring
@@ -591,7 +634,11 @@ class CoverageEngine:
             idx = jnp.where(has_new, idx, corpus_mat.shape[0])
             mat = corpus_mat.at[idx].set(bitmaps, mode="drop")
             draws = sample_calls(key, prios, prev, enabled)
-            return cover, mat, has_new, draws
+            if ds is not None:
+                svec = _bump(svec, hinc, "admit_batches", "admit_inputs",
+                             "admit_admitted", valid, has_new,
+                             extra=[("admit_draws", prev.shape[0])])
+            return cover, mat, has_new, draws, svec
 
         @jax.jit
         def _diff_vs(base, call_ids, pc_idx, valid, flakes):
@@ -750,6 +797,28 @@ class CoverageEngine:
         valid = jnp.asarray(valid, jnp.bool_)
         return call_ids, pc_idx, valid
 
+    def _ts_in(self):
+        """(svec, hinc) operands for an instrumented dispatch.  With
+        telemetry disabled both are a persistent 1-element dummy (the
+        jitted fns keep one signature; the bumps are never traced)."""
+        if self.tstats is None:
+            return self._ts_dummy, self._ts_dummy
+        return self.tstats.vec, self.tstats.take_pending_device()
+
+    def _ts_out(self, svec) -> None:
+        if self.tstats is not None:
+            self.tstats.commit(svec)
+
+    @_locked
+    def telemetry_flush(self, reset: bool = False):
+        """One-transfer readback of the device stat vector (int64
+        totals), optionally folding into host cumulatives and zeroing
+        the device slots; None when telemetry is disabled.  Runs under
+        the state lock so a reset cannot race an in-flight dispatch."""
+        if self.tstats is None:
+            return None
+        return self.tstats.flush(reset=reset)
+
     @_locked
     def update_batch_async(self, call_ids, pc_idx, valid) -> UpdateResult:
         """Dispatch the hot step WITHOUT a host sync: result.has_new is a
@@ -758,8 +827,10 @@ class CoverageEngine:
         reference semantics while the tunnel round-trip overlaps with
         host work."""
         call_ids, pc_idx, valid = self._fit(call_ids, pc_idx, valid)
-        self.max_cover, new, has_new, bitmaps = self._update_fn(
-            self.max_cover, call_ids, pc_idx, valid)
+        svec, hinc = self._ts_in()
+        self.max_cover, new, has_new, bitmaps, svec = self._update_fn(
+            self.max_cover, call_ids, pc_idx, valid, svec, hinc)
+        self._ts_out(svec)
         return UpdateResult(has_new=has_new, new_bits=new, bitmaps=bitmaps)
 
     def update_batch(self, call_ids, pc_idx, valid) -> UpdateResult:
@@ -786,19 +857,28 @@ class CoverageEngine:
         pc_idx = np.asarray(pc_idx)
         valid = np.asarray(valid)
         blocks = None
-        if self.max_touched_blocks and self.mesh is None:
+        sparse_cfg = bool(self.max_touched_blocks) and self.mesh is None
+        if sparse_cfg:
             blocks = touched_blocks(pc_idx, valid, self.npcs,
                                     self.block_words,
                                     self.max_touched_blocks)
         if blocks is None:
+            if sparse_cfg and self.tstats is not None:
+                # footprint overflowed max_touched_blocks: the dense
+                # fallback ran where sparse was configured
+                self.tstats.inc("sparse_fallback")
             cs, ps, vs = self._fit(call_ids, pc_idx, valid)
-            self.max_cover, new, has_new, _bm = self._update_fn(
-                self.max_cover, cs, ps, vs)
+            svec, hinc = self._ts_in()
+            self.max_cover, new, has_new, _bm, svec = self._update_fn(
+                self.max_cover, cs, ps, vs, svec, hinc)
+            self._ts_out(svec)
             return SparseUpdateResult(has_new=has_new, new_bits=new,
                                       blocks=None)
         cs, ps, vs = self._fit(call_ids, pc_idx, valid)
-        self.max_cover, new, has_new = self._update_sparse_fn(
-            self.max_cover, cs, ps, vs, jnp.asarray(blocks))
+        svec, hinc = self._ts_in()
+        self.max_cover, new, has_new, svec = self._update_sparse_fn(
+            self.max_cover, cs, ps, vs, jnp.asarray(blocks), svec, hinc)
+        self._ts_out(svec)
         return SparseUpdateResult(has_new=has_new, new_bits=new,
                                   blocks=blocks)
 
@@ -909,20 +989,23 @@ class CoverageEngine:
             choices = (self.sample_next_calls(choice_prev)
                        if choice_prev is not None else None)
             return np.asarray(has_new), None, choices
+        svec, hinc = self._ts_in()
         if choice_prev is None:
-            self.corpus_cover, self.corpus_mat, has_new = \
+            self.corpus_cover, self.corpus_mat, has_new, svec = \
                 self._admit_if_new_fn(
                     self.corpus_cover, self.corpus_mat, self.flakes,
-                    call_ids, pc_idx, valid, jnp.int32(self.corpus_len))
+                    call_ids, pc_idx, valid, jnp.int32(self.corpus_len),
+                    svec, hinc)
             choices = None
         else:
-            self.corpus_cover, self.corpus_mat, has_new, choices = \
+            self.corpus_cover, self.corpus_mat, has_new, choices, svec = \
                 self._admit_choices_fn(
                     self.corpus_cover, self.corpus_mat, self.flakes,
                     call_ids, pc_idx, valid, jnp.int32(self.corpus_len),
                     self._next_key(), self.prios, self.enabled,
-                    jnp.asarray(choice_prev, jnp.int32))
+                    jnp.asarray(choice_prev, jnp.int32), svec, hinc)
             choices = np.asarray(choices)
+        self._ts_out(svec)
         has_new = np.asarray(has_new)
         admitted = np.nonzero(has_new)[0]
         rows = np.arange(self.corpus_len, self.corpus_len + len(admitted))

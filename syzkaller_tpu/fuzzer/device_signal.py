@@ -41,7 +41,8 @@ class DeviceSignal:
 
     def __init__(self, ncalls: int, npcs: int = 1 << 16,
                  flush_batch: int = 32, max_pcs: int = 512,
-                 corpus_cap: int = 1 << 14, seed: int = 0):
+                 corpus_cap: int = 1 << 14, seed: int = 0,
+                 telemetry=None):
         from syzkaller_tpu.cover.engine import CoverageEngine
 
         # wide bitmaps (≥128k PCs) get the word-block-sparse hot step:
@@ -49,10 +50,14 @@ class DeviceSignal:
         # the full width; narrow bitmaps keep the plain dense step
         # (the sparse gather/scatter wouldn't pay for itself)
         sparse_blocks = 512 if npcs >= (1 << 17) else 0
+        # telemetry (a telemetry.device.DeviceStats) rides the engine's
+        # fused dispatches: dense/sparse dispatch counts, fallback rate,
+        # and the exec-latency histogram the fuzzer feeds
+        self.tstats = telemetry
         self.engine = CoverageEngine(
             npcs=npcs, ncalls=ncalls, corpus_cap=corpus_cap,
             batch=flush_batch, max_pcs_per_exec=max_pcs, seed=seed,
-            max_touched_blocks=sparse_blocks)
+            max_touched_blocks=sparse_blocks, telemetry=telemetry)
         self.pcmap = PcMap(npcs)
         self.B = flush_batch
         self.K = max_pcs

@@ -31,7 +31,7 @@ import numpy as np
 
 from syzkaller_tpu import ipc
 from syzkaller_tpu import prog as P
-from syzkaller_tpu import rpc
+from syzkaller_tpu import rpc, telemetry
 from syzkaller_tpu.cover import sets
 from syzkaller_tpu.fuzzer import host as host_mod
 from syzkaller_tpu.prog import model as M
@@ -67,6 +67,30 @@ class Fuzzer:
                       ipc.FLAG_COVER | ipc.FLAG_DEDUP_COVER | ipc.FLAG_FAKE_COVER)
         self.leak = leak and os.path.exists("/sys/kernel/debug/kmemleak")
         self.seed = seed
+        # typed stat plane (ref fuzzer.go ships counter deltas on every
+        # Poll): counters replace the raw stats dict; keys here ARE the
+        # legacy Poll wire names, so the manager-side aggregation and
+        # its html view are byte-identical
+        self.registry = telemetry.Registry()
+        self.tracer = telemetry.Tracer(name=name)
+        self._ts_shipped = None          # poll-delta watermark for the
+        #                                  device stat vector (if any)
+        f_exec = self.registry.counter(
+            "syz_exec_total", "executed programs by stat class",
+            labels=("stat",))
+        self._stat_counters = {
+            "exec total": f_exec.labels(stat="total"),
+            "exec gen": f_exec.labels(stat="gen"),
+            "exec fuzz": f_exec.labels(stat="fuzz"),
+            "exec candidate": f_exec.labels(stat="candidate"),
+            "exec triage": f_exec.labels(stat="triage"),
+            "exec minimize": f_exec.labels(stat="minimize"),
+            "new inputs": self.registry.counter(
+                "syz_fuzzer_new_inputs_total",
+                "triaged inputs sent to the manager"),
+        }
+        self._h_exec = self.registry.histogram(
+            "syz_exec_seconds", "executor round-trip latency")
         # Device-resident signal path (VERDICT r1 #3): per-exec diffs,
         # flakes and corpus membership run on the CoverageEngine; falls
         # back to the numpy sorted-set path when JAX is unavailable.
@@ -82,7 +106,7 @@ class Fuzzer:
                 self.signal = DeviceSignal(
                     ncalls=self.table.count, npcs=npcs,
                     flush_batch=flush_batch, corpus_cap=corpus_cap,
-                    seed=seed)
+                    seed=seed, telemetry=telemetry.DeviceStats())
             except Exception as e:  # no jax / no backend: degrade to host
                 log.logf(0, "device signal unavailable (%s); using host sets", e)
         # (prog, call_index, canonical cover) awaiting a device verdict
@@ -102,9 +126,6 @@ class Fuzzer:
         self.device_choices: deque[int] = deque()
         self._mu = threading.Lock()
         self._stop = False
-        self.stats = {"exec total": 0, "exec gen": 0, "exec fuzz": 0,
-                      "exec candidate": 0, "exec triage": 0,
-                      "exec minimize": 0, "new inputs": 0}
         self.ct: "P.ChoiceTable | None" = None
         self.enabled_ids: list[int] = []
         # ONE gate shared by all procs: the leak-scan callback must run
@@ -115,7 +136,8 @@ class Fuzzer:
     # -- startup -----------------------------------------------------------
 
     def connect(self) -> None:
-        r = self.client.call("Manager.Connect", {"name": self.name})
+        r = self.client.call("Manager.Connect", {"name": self.name},
+                             span=self.tracer.new_trace(origin=self.name))
         prios = None
         if r.get("prios"):
             raw = np.frombuffer(rpc.unb64(r["prios"]), np.float32)
@@ -135,7 +157,8 @@ class Fuzzer:
         """enabled ∩ host-supported ∩ transitive closure (ref :307-342)."""
         enabled = {self.table.call_map[n] for n in enabled_names
                    if n in self.table.call_map}
-        supported = host_mod.detect_supported(self.table)
+        supported = host_mod.detect_supported(self.table,
+                                              registry=self.registry)
         enabled &= supported
         closed = self.table.transitively_enabled_calls(enabled)
         dropped = enabled - closed
@@ -181,12 +204,17 @@ class Fuzzer:
     def execute(self, env: ipc.Env, p: M.Prog, stat: str,
                 pid: int) -> "ipc.ExecResult | None":
         self.log_program(pid, p)
-        with self._mu:
-            self.stats["exec total"] += 1
-            self.stats[stat] += 1
+        self._stat_counters["exec total"].inc()
+        self._stat_counters[stat].inc()
         for attempt in range(3):
             try:
-                return env.exec(p)
+                t0 = time.monotonic()
+                res = env.exec(p)
+                dt = time.monotonic() - t0
+                self._h_exec.observe(dt)
+                if self.signal is not None and self.signal.tstats is not None:
+                    self.signal.tstats.observe("exec_latency", dt)
+                return res
             except ipc.ExecutorFailure as e:
                 log.logf(0, "executor failure (try %d): %s", attempt, e)
                 time.sleep(0.5 * (attempt + 1))
@@ -285,6 +313,11 @@ class Fuzzer:
         new_cover = self._triage_new(call_id, item.cover)
         if len(new_cover) == 0 and not item.from_candidate:
             return
+        # one trace per admission attempt: hops accumulate here
+        # (re-exec, minimize), ride the NewInput params, and finish
+        # manager-side (coalescer queue + device dispatch)
+        span = self.tracer.new_trace(origin=self.name)
+        t_triage = time.monotonic()
         # 3× re-execution: intersect stable cover, accumulate flakes
         min_cover = item.cover
         for _ in range(3):
@@ -324,14 +357,15 @@ class Fuzzer:
                 # program even after chunked/full-matrix admissions
                 self.signal.merge_corpus(cid, min_cover,
                                          corpus_index=len(self.corpus) - 1)
-            self.stats["new inputs"] += 1
+        self._stat_counters["new inputs"].inc()
+        span.add_hop("fuzzer:triage+minimize", time.monotonic() - t_triage)
         self.client.call("Manager.NewInput", {
             "name": self.name,
             "call": item.prog.calls[item.call_index].meta.name,
             "prog": rpc.b64(data),
             "call_index": item.call_index,
             "cover": [int(x) for x in min_cover],
-        })
+        }, span=span)
 
     def minimize_input(self, env: ipc.Env, item: TriageItem,
                        stable_new: np.ndarray, pid: int
@@ -499,13 +533,29 @@ class Fuzzer:
         # periodic flush so low-throughput runs don't strand signal in
         # the pending buffer past the batch boundary
         self.flush_signal(force=True)
+        # ship counter DELTAS under the legacy wire keys (ref
+        # fuzzer.go:246-252's grab-and-reset, now a drain watermark)
+        stats = {k: c.drain() for k, c in self._stat_counters.items()}
+        if self.signal is not None and self.signal.tstats is not None:
+            # the fuzzer-side device stat vector flows to the manager's
+            # stat plane as Poll deltas too (one small readback per
+            # poll — cadence-bound, not per-exec)
+            ds = self.signal.tstats
+            vals = ds.values()
+            if self._ts_shipped is None:
+                self._ts_shipped = np.zeros_like(vals)
+            delta, self._ts_shipped = vals - self._ts_shipped, vals
+            for key, wire in (("dense_batches", "cover dense dispatches"),
+                              ("sparse_batches", "cover sparse dispatches"),
+                              ("sparse_fallback", "cover sparse fallbacks")):
+                d = int(delta[ds.slot(key)])
+                if d:
+                    stats[wire] = d
         with self._mu:
-            stats = dict(self.stats)
-            for k in self.stats:
-                self.stats[k] = 0
             need = len(self.candidate_q) == 0
         r = self.client.call("Manager.Poll", {
-            "name": self.name, "stats": stats, "need_candidates": need})
+            "name": self.name, "stats": stats, "need_candidates": need},
+            span=self.tracer.new_trace(origin=self.name))
         for cp in r.get("candidates", []):
             self.candidate_q.append((rpc.unb64(cp["prog"]),
                                      bool(cp.get("minimized"))))

@@ -139,7 +139,22 @@ def _syscall_supported(name: str, syms: "frozenset[str] | None") -> bool:
                     "exit", "exit_group")
 
 
-def detect_supported(table: SyscallTable) -> set[T.Syscall]:
+def detect_supported(table: SyscallTable,
+                     registry=None) -> set[T.Syscall]:
+    """`registry` (a telemetry.Registry; None = the process default)
+    gets the probe-outcome counters: how this host's call list was
+    derived is production-debuggable from /metrics instead of one log
+    line at startup."""
+    from syzkaller_tpu.telemetry import registry as reg_mod
+
+    reg = registry if registry is not None else reg_mod.default_registry()
+    probe_c = reg.counter(
+        "syz_host_probe_total",
+        "probe-based capability fallback outcomes by verdict",
+        labels=("verdict",))
+    source_c = reg.counter(
+        "syz_host_detect_total", "capability detection runs by source",
+        labels=("source",))
     syms = _kallsyms()
     probed: "dict[int, bool]" = {}
     if syms is None:
@@ -150,11 +165,18 @@ def detect_supported(table: SyscallTable) -> set[T.Syscall]:
         probed = _probe_nrs(nrs)
         if probed:
             n_off = sum(1 for v in probed.values() if not v)
+            probe_c.labels(verdict="supported").inc(len(probed) - n_off)
+            probe_c.labels(verdict="enosys").inc(n_off)
+            source_c.labels(source="probe").inc()
             log.logf(0, "host: kallsyms unreadable; probed %d syscall "
                      "NRs, %d ENOSYS", len(probed), n_off)
         else:
+            probe_c.labels(verdict="failed").inc()
+            source_c.labels(source="permissive").inc()
             log.logf(0, "host: kallsyms unreadable and probing failed; "
                      "assuming all calls supported")
+    else:
+        source_c.labels(source="kallsyms").inc()
     out: set[T.Syscall] = set()
     for call in table.calls:
         name = call.call_name

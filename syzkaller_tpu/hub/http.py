@@ -38,7 +38,8 @@ def summary(hub, start_time: float) -> str:
             f"added {total_added}, pending {total_new}</p>"
             f"<table><tr><th>manager</th><th>cursor</th><th>added</th>"
             f"<th>pending</th></tr>{table}</table>"
-            f"<p><a href='/log'>log</a></p>")
+            f"<p><a href='/metrics'>metrics</a> | "
+            f"<a href='/log'>log</a></p>")
 
 
 def serve(hub, host: str, port: int) -> ThreadingHTTPServer:
@@ -48,10 +49,11 @@ def serve(hub, host: str, port: int) -> ThreadingHTTPServer:
         def log_message(self, *args):
             pass
 
-        def _send(self, body: str, code: int = 200):
+        def _send(self, body: str, code: int = 200,
+                  ctype: str = "text/html; charset=utf-8"):
             data = body.encode()
             self.send_response(code)
-            self.send_header("Content-Type", "text/html; charset=utf-8")
+            self.send_header("Content-Type", ctype)
             self.send_header("Content-Length", str(len(data)))
             self.end_headers()
             self.wfile.write(data)
@@ -60,6 +62,11 @@ def serve(hub, host: str, port: int) -> ThreadingHTTPServer:
             try:
                 if self.path.split("?")[0] == "/":
                     self._send(summary(hub, start_time))
+                elif self.path.split("?")[0] == "/metrics":
+                    from syzkaller_tpu.telemetry import expo
+                    self._send(expo.prometheus_text([hub.registry]),
+                               ctype="text/plain; version=0.0.4; "
+                                     "charset=utf-8")
                 elif self.path.startswith("/log"):
                     self._send("<pre>%s</pre>" %
                                html_mod.escape(log.cached_log()))

@@ -16,7 +16,7 @@ import argparse
 import threading
 import time
 
-from syzkaller_tpu import rpc
+from syzkaller_tpu import rpc, telemetry
 from syzkaller_tpu.hub.state import HubState
 from syzkaller_tpu.utils import log
 
@@ -27,14 +27,42 @@ class Hub:
         self.key = key
         self.state = HubState(workdir)
         self._mu = threading.Lock()
+        # federation stat plane: same typed registry as the manager's,
+        # served as /metrics by the hub's HTTP page
+        self.registry = telemetry.Registry()
+        r = self.registry
+        self._c_auth_failed = r.counter(
+            "syz_hub_auth_failures_total", "rejected shared-key auths")
+        self._c_added = r.counter(
+            "syz_hub_progs_added_total",
+            "fresh programs accepted into the hub corpus")
+        self._c_shipped = r.counter(
+            "syz_hub_progs_shipped_total",
+            "programs shipped to managers on Sync")
+        self._f_rpc = r.counter(
+            "syz_hub_rpc_requests_total", "hub RPC requests by method",
+            labels=("method",))
+        self._h_rpc = r.histogram(
+            "syz_hub_rpc_request_seconds", "hub RPC handling latency")
+        r.gauge("syz_hub_corpus_size", "programs in the federated corpus",
+                fn=lambda: len(self.state.seq))
+        r.gauge("syz_hub_managers", "managers known to the hub",
+                fn=lambda: len(self.state.managers))
         host, _, port = addr.rpartition(":")
         self.server = rpc.RpcServer(host or "127.0.0.1", int(port or 0))
         self.server.register("Hub.Connect", self.rpc_connect)
         self.server.register("Hub.Sync", self.rpc_sync)
+        self.server.observer = self._rpc_observer
         self.addr = self.server.addr
+
+    def _rpc_observer(self, method: str, seconds: float,
+                      params: dict) -> None:
+        self._f_rpc.labels(method=method or "?").inc()
+        self._h_rpc.observe(seconds)
 
     def _auth(self, params: dict) -> str:
         if self.key and params.get("key") != self.key:
+            self._c_auth_failed.inc()
             raise PermissionError("invalid hub key")
         name = params.get("name", "")
         if not name:
@@ -56,6 +84,8 @@ class Hub:
         with self._mu:
             fresh = self.state.add(name, add)
             progs, more = self.state.pending(name)
+        self._c_added.inc(fresh)
+        self._c_shipped.inc(len(progs))
         log.logf(1, "hub: sync %s: +%d fresh, -> %d progs (%d more)",
                  name, fresh, len(progs), more)
         return {"progs": [rpc.b64(p) for p in progs], "more": more}

@@ -25,6 +25,7 @@ broadcast to the other fuzzers and persist to disk.
 from __future__ import annotations
 
 import threading
+import time
 from collections import deque
 from dataclasses import dataclass, field
 
@@ -44,6 +45,8 @@ class _Pending:
     cover: np.ndarray
     wire_prog: str
     wire_cover: list
+    trace: object = None          # telemetry.trace.SpanContext | None
+    enqueued: float = field(default_factory=time.monotonic)
     done: threading.Event = field(default_factory=threading.Event)
     result: dict = field(default_factory=dict)
 
@@ -83,12 +86,13 @@ class AdmissionCoalescer:
 
     def submit(self, name: str, sig: bytes, data: bytes, call: str,
                call_index: int, call_id: int, cover: np.ndarray,
-               wire_prog: str, wire_cover: list) -> dict:
+               wire_prog: str, wire_cover: list, trace=None) -> dict:
         """Enqueue one admission and block until its batch resolves.
         Called from many RPC handler threads concurrently."""
         p = _Pending(name=name, sig=sig, data=data, call=call,
                      call_index=call_index, call_id=call_id, cover=cover,
-                     wire_prog=wire_prog, wire_cover=wire_cover)
+                     wire_prog=wire_prog, wire_cover=wire_cover,
+                     trace=trace)
         with self._cv:
             if self._stop:
                 return {}
@@ -160,7 +164,9 @@ class AdmissionCoalescer:
         mgr = self.mgr
         if len(batch) > 1:
             self.stat_coalesced += len(batch)
+            mgr._c_coal_inputs.inc(len(batch))
         self.stat_batches += 1
+        mgr._c_coal_batches.inc()
         with mgr._admit_mu:
             # host-side dedup FIRST (same early-out as the serial path):
             # already-in-corpus or repeated-in-batch sigs resolve to the
@@ -200,16 +206,29 @@ class AdmissionCoalescer:
             pidx[:n] = idx
             pval[:n] = valid
             prev = np.full((self.choices_per_step,), -1, np.int32)
+            t_disp = time.monotonic()
             has_new, rows, choices = mgr.engine.admit_batch(
                 call_ids, pidx, pval, choice_prev=prev)
+            t_done = time.monotonic()
+            ds = mgr.device_stats
+            if ds is not None:
+                # one lock acquisition for the whole batch's latencies
+                ds.observe_batch("admission_latency",
+                                 [t_done - p.enqueued for p in fresh])
+            for p in fresh:
+                if p.trace is not None:
+                    p.trace.add_hop("coalescer:gather",
+                                    t_disp - p.enqueued)
+                    p.trace.add_hop("coalescer:device dispatch",
+                                    t_done - t_disp)
+                    mgr.tracer.record(p.trace, final_hop="manager:admit",
+                                      dur=t_done - p.enqueued)
             self._refill_choices(choices)
             admitted: list[tuple[_Pending, int]] = []
             cursor = 0
             with mgr._mu:
                 for j, p in enumerate(fresh):
                     if not has_new[j]:
-                        mgr.stats["rejected inputs"] = \
-                            mgr.stats.get("rejected inputs", 0) + 1
                         continue
                     # rows[k] is the corpus row of the k-th admitted
                     # entry in submission order (None: matrix full,
@@ -218,6 +237,11 @@ class AdmissionCoalescer:
                     cursor += 1
                     mgr._record_admitted(p, row)
                     admitted.append((p, row))
+            # stat-plane bookkeeping ONCE per batch, not per input
+            if len(admitted) < len(fresh):
+                mgr._record_rejected(len(fresh) - len(admitted))
+            if admitted:
+                mgr._record_admit_rate(len(admitted))
         # resolve tickets BEFORE persistence: callers resubmit their
         # next input while the drainer writes this batch's programs to
         # disk (persistence stays ordered inside the drainer, lag

@@ -46,6 +46,9 @@ class Config:
     admit_batch: int = 64              # NewInput coalescer batch size
     #                                    (<=1 = serial per-input admission)
     fuzzer_device: bool = False        # fuzzers run signal diffs on device
+    telemetry: bool = True             # metrics registry + device stat
+    #                                    vector + /metrics + trace spans
+    telemetry_interval: float = 60.0   # snapshot persistence period (s)
     mesh: int = 0                      # shard the PC axis over N devices
     #                                    (0/1 = single-device engine;
     #                                    BASELINE config #4's device mesh)
@@ -121,6 +124,9 @@ class Config:
         if not 0 <= self.admit_batch <= 4096:
             raise ConfigError(
                 f"invalid admit_batch {self.admit_batch} (0..4096)")
+        if self.telemetry_interval <= 0:
+            raise ConfigError(
+                f"invalid telemetry_interval {self.telemetry_interval}")
         # NOTE: device availability for `mesh` is checked when the
         # manager builds the engine (cover.engine.pc_mesh raises) —
         # config linting must not initialize an accelerator runtime.

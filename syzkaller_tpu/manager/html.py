@@ -24,10 +24,11 @@ def serve(mgr, host: str, port: int) -> ThreadingHTTPServer:
         def log_message(self, *args):
             pass
 
-        def _send(self, body: str, code: int = 200):
+        def _send(self, body: str, code: int = 200,
+                  ctype: str = "text/html; charset=utf-8"):
             data = body.encode()
             self.send_response(code)
-            self.send_header("Content-Type", "text/html; charset=utf-8")
+            self.send_header("Content-Type", ctype)
             self.send_header("Content-Length", str(len(data)))
             self.end_headers()
             self.wfile.write(data)
@@ -38,6 +39,16 @@ def serve(mgr, host: str, port: int) -> ThreadingHTTPServer:
             try:
                 if u.path == "/":
                     self._send(summary(mgr))
+                elif u.path == "/metrics":
+                    # Prometheus text exposition (telemetry/expo.py)
+                    self._send(mgr.metrics_text(),
+                               ctype="text/plain; version=0.0.4; "
+                                     "charset=utf-8")
+                elif u.path == "/telemetry":
+                    import json
+                    self._send(json.dumps(mgr.telemetry_snapshot(),
+                                          default=str),
+                               ctype="application/json")
                 elif u.path == "/corpus":
                     self._send(corpus(mgr))
                 elif u.path == "/crash":
@@ -93,6 +104,8 @@ def summary(mgr) -> str:
             f"fuzzers {_esc(fuzzers)}</p>"
             f"<p><a href='/prio'>priorities</a> | "
             f"<a href='/cover'>coverage</a> | "
+            f"<a href='/metrics'>metrics</a> | "
+            f"<a href='/telemetry'>telemetry</a> | "
             f"<a href='/profile'>profile</a> | <a href='/log'>log</a></p>"
             f"<h3>Stats</h3><table>{rows}</table>"
             f"<h3>Crashes</h3><table><tr><th>description</th><th>count</th>"

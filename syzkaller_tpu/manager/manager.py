@@ -27,13 +27,14 @@ from dataclasses import dataclass, field
 import numpy as np
 
 from syzkaller_tpu import prog as P
-from syzkaller_tpu import rpc, vm
+from syzkaller_tpu import rpc, telemetry, vm
 from syzkaller_tpu.cover.engine import CoverageEngine
 from syzkaller_tpu.fuzzer import PcMap
 from syzkaller_tpu.manager.config import Config
 from syzkaller_tpu.manager.persistent import PersistentSet
 from syzkaller_tpu.report import symbolize_report
 from syzkaller_tpu.sys.table import load_table
+from syzkaller_tpu.telemetry import expo
 from syzkaller_tpu.utils import log
 from syzkaller_tpu.vm.monitor import monitor_execution
 
@@ -70,6 +71,14 @@ class Manager:
             files=None if cfg.descriptions in ("all", "linux")
             else [cfg.descriptions])
 
+        # telemetry plane: typed registry + trace ring always exist (the
+        # legacy stats dict is a view over the registry); the DEVICE
+        # stat vector and RPC observer follow the `telemetry` knob
+        self.registry = telemetry.Registry()
+        self.tracer = telemetry.Tracer(name=cfg.name)
+        self.device_stats = telemetry.DeviceStats() if cfg.telemetry else None
+        self._build_metrics()
+
         # the config `mesh` knob shards the engine's PC axis over N
         # devices (BASELINE config #4: device-resident global coverage
         # matrix with on-mesh merges); 0/1 keeps a single-device engine
@@ -79,7 +88,8 @@ class Manager:
             mesh = pc_mesh(cfg.mesh, cfg.mesh_platform)
         self.engine = CoverageEngine(
             npcs=cfg.npcs, ncalls=self.table.count,
-            corpus_cap=cfg.corpus_cap, batch=cfg.flush_batch, mesh=mesh)
+            corpus_cap=cfg.corpus_cap, batch=cfg.flush_batch, mesh=mesh,
+            telemetry=self.device_stats)
         self.static_prios = P.calculate_priorities(self.table)
         self.engine.set_priorities(self.static_prios)
         self.enabled_names = cfg.enabled_calls(self.table)
@@ -109,7 +119,14 @@ class Manager:
         self.corpus: dict[bytes, CorpusItem] = {}
 
         self.fuzzers: dict[str, FuzzerConn] = {}
-        self.stats: dict[str, int] = {}
+        # legacy dict[str,int] facade over the registry: Poll payload
+        # aggregation and manager/html.py keep their dict idioms while
+        # every increment lands in a typed series
+        self.stats = telemetry.StatsView(self.registry, aliases={
+            "manager new inputs": self._c_new_inputs,
+            "rejected inputs": self._c_rejected,
+            "crashes": self._c_crashes,
+        })
         self.crash_types: dict[str, int] = {}
         self.start_time = time.time()
         self._mu = threading.Lock()
@@ -136,6 +153,8 @@ class Manager:
         self.server.register("Manager.Check", self.rpc_check)
         self.server.register("Manager.Poll", self.rpc_poll)
         self.server.register("Manager.NewInput", self.rpc_new_input)
+        if cfg.telemetry:
+            self.server.observer = self._rpc_observer
         self.rpc_port = self.server.addr[1]
         self.http_server = None
         self.vm_threads: list[threading.Thread] = []
@@ -144,6 +163,92 @@ class Manager:
     def _split_addr(addr: str) -> tuple[str, int]:
         host, _, port = addr.rpartition(":")
         return host or "127.0.0.1", int(port or 0)
+
+    # -- telemetry ---------------------------------------------------------
+
+    def _build_metrics(self) -> None:
+        """Pre-register the core series so /metrics serves the full
+        shape from the first scrape (dashboards key on series presence,
+        not just values)."""
+        r = self.registry
+        self._c_inputs = r.counter(
+            "syz_admission_inputs_total", "NewInput RPCs received")
+        self._c_new_inputs = r.counter(
+            "syz_admission_new_inputs_total",
+            "inputs admitted into the global corpus")
+        self._c_rejected = r.counter(
+            "syz_admission_rejected_total",
+            "inputs rejected by the device diff gate (no new signal)")
+        self._c_crashes = r.counter("syz_crash_total", "VM crashes saved")
+        self._c_coal_batches = r.counter(
+            "syz_admission_batches_total", "coalescer fused dispatches")
+        self._c_coal_inputs = r.counter(
+            "syz_admission_coalesced_total",
+            "inputs that shared a fused admission dispatch")
+        self._c_choices_served = r.counter(
+            "syz_choice_ring_served_total",
+            "Poll choices served from the pre-drawn admission ring")
+        self._c_choices_topup = r.counter(
+            "syz_choice_topup_total",
+            "Poll choices drawn by the direct sampling dispatch")
+        self._f_rpc = r.counter(
+            "syz_rpc_requests_total", "RPC requests by method",
+            labels=("method",))
+        self._h_rpc = r.histogram(
+            "syz_rpc_request_seconds", "server-side RPC handling latency")
+        self._f_vm_execs = r.counter(
+            "syz_vm_execs_total", "per-VM executed programs (Poll deltas)",
+            labels=("vm",))
+        self._f_vm_rate = r.ewma(
+            "syz_vm_exec_rate", "per-VM exec throughput (EWMA, 1/s)",
+            labels=("vm",), tau=60.0)
+        self._e_exec_rate = r.ewma(
+            "syz_exec_rate", "fleet exec throughput (EWMA, 1/s)", tau=60.0)
+        self._e_admit_rate = r.ewma(
+            "syz_admission_rate", "corpus admission rate (EWMA, 1/s)",
+            tau=60.0)
+        for m in ("Manager.Connect", "Manager.Check", "Manager.Poll",
+                  "Manager.NewInput"):
+            self._f_rpc.labels(method=m)
+        r.gauge("syz_uptime_seconds", "manager uptime",
+                fn=lambda: time.time() - self.start_time)
+        r.gauge("syz_corpus_size", "programs in the global corpus",
+                fn=lambda: len(self.corpus))
+        r.gauge("syz_corpus_candidates", "re-triage candidates pending",
+                fn=lambda: len(self.candidates))
+        r.gauge("syz_fuzzers_connected", "connected fuzzer processes",
+                fn=lambda: len(self.fuzzers))
+        r.gauge("syz_engine_corpus_rows", "device corpus matrix rows",
+                fn=lambda: self.engine.corpus_len)
+        r.gauge("syz_crash_types", "distinct crash titles seen",
+                fn=lambda: len(self.crash_types))
+        self._f_vm_outcomes = r.counter(
+            "syz_vm_outcomes_total", "VM run outcomes by class",
+            labels=("outcome",))
+
+    def _rpc_observer(self, method: str, seconds: float,
+                      params: dict) -> None:
+        """RpcServer tap: per-method counters/latency + completed spans
+        for traced Connect/Check/Poll requests (NewInput traces are
+        recorded by the admission path with their full hop chain)."""
+        self._f_rpc.labels(method=method or "?").inc()
+        self._h_rpc.observe(seconds)
+        if method != "Manager.NewInput":
+            ctx = telemetry.SpanContext.from_wire(params.get("trace"))
+            if ctx is not None:
+                ctx.mark_transit()
+                self.tracer.record(ctx, final_hop=f"manager:{method}",
+                                   dur=seconds)
+
+    def telemetry_snapshot(self, traces: int = 16) -> dict:
+        """JSON-ready snapshot of the registry, device stat vector, and
+        recent trace spans (the /telemetry endpoint + persistence body)."""
+        return expo.snapshot([self.registry], self.device_stats,
+                             self.tracer, traces=traces)
+
+    def metrics_text(self) -> str:
+        """Prometheus text exposition (the /metrics endpoint body)."""
+        return expo.prometheus_text([self.registry], self.device_stats)
 
     # -- RPC handlers (ref manager.go:552-656) -----------------------------
 
@@ -180,8 +285,12 @@ class Manager:
     def rpc_poll(self, params: dict) -> dict:
         name = params.get("name", "?")
         for k, v in (params.get("stats") or {}).items():
-            with self._mu:
-                self.stats[k] = self.stats.get(k, 0) + int(v)
+            self.stats.bump(k, int(v))
+            if k == "exec total" and int(v) > 0:
+                # per-VM exec throughput: absolute counters + EWMA rates
+                self._f_vm_execs.labels(vm=name).inc(int(v))
+                self._f_vm_rate.labels(vm=name).add(int(v))
+                self._e_exec_rate.add(int(v))
         with self._mu:
             conn = self.fuzzers.get(name)
             if conn is None:
@@ -197,10 +306,16 @@ class Manager:
         # remainder when the ring runs dry
         choices = (self.coalescer.pop_choices(CHOICES_PER_POLL)
                    if self.coalescer is not None else [])
+        self._c_choices_served.inc(len(choices))
         short = CHOICES_PER_POLL - len(choices)
         if short > 0:
+            t0 = time.monotonic()
             choices += [int(x) for x in self.engine.sample_next_calls(
                 np.full((short,), -1, np.int32))]
+            if self.device_stats is not None:
+                self.device_stats.observe("choice_draw_latency",
+                                          time.monotonic() - t0)
+            self._c_choices_topup.inc(short)
         return {"candidates": cands, "new_inputs": inputs,
                 "choices": choices}
 
@@ -214,6 +329,10 @@ class Manager:
         meta = self.table.call_map.get(call)
         if meta is None:
             return {}
+        self._c_inputs.inc()
+        trace = telemetry.SpanContext.from_wire(params.get("trace"))
+        if trace is not None:
+            trace.mark_transit()
         if self.coalescer is not None:
             # batched admission plane: enqueue and block on the ticket;
             # the drainer aggregates concurrent NewInputs into one fused
@@ -222,37 +341,45 @@ class Manager:
                 name=name, sig=sig, data=data, call=call,
                 call_index=call_index, call_id=meta.id, cover=cover,
                 wire_prog=params.get("prog"),
-                wire_cover=params.get("cover", []))
+                wire_cover=params.get("cover", []), trace=trace)
         return self._admit_serial(name, sig, data, call, call_index,
-                                  meta.id, cover, params)
+                                  meta.id, cover, params, trace)
 
     def _admit_serial(self, name: str, sig: bytes, data: bytes, call: str,
                       call_index: int, call_id: int, cover: np.ndarray,
-                      params: dict) -> dict:
+                      params: dict, trace=None) -> dict:
         """The admit_batch<=1 path: one admission at a time.  Concurrent
         duplicates would both pass the diff gate before either merged
         (TOCTOU), so _admit_mu is held across the dispatch; gate + merge
         run as ONE fused device call so the lock covers a single tunnel
         round-trip (round-2 verdict weak #5)."""
+        t_start = time.monotonic()
         with self._admit_mu:
             with self._mu:
                 if sig in self.corpus:
                     return {}
             idx, valid = self.pcmap.map_batch([cover], K=256)
+            t_disp = time.monotonic()
             has_new, rows = self.engine.admit_if_new(
                 np.array([call_id], np.int32), idx, valid)
+            if self.device_stats is not None:
+                self.device_stats.observe("admission_latency",
+                                          time.monotonic() - t_start)
+            if trace is not None:
+                trace.add_hop("manager:device dispatch",
+                              time.monotonic() - t_disp)
+                self.tracer.record(trace, final_hop="manager:admit",
+                                   dur=time.monotonic() - t_start)
             if not has_new[0]:
-                with self._mu:
-                    self.stats["rejected inputs"] = \
-                        self.stats.get("rejected inputs", 0) + 1
+                self._c_rejected.inc()
                 return {}
             row = (int(rows[0]) if rows is not None and len(rows) else -1)
             with self._mu:
                 self.corpus[sig] = CorpusItem(
                     data=data, call=call, call_index=call_index,
                     corpus_row=row)
-                self.stats["manager new inputs"] = \
-                    self.stats.get("manager new inputs", 0) + 1
+                self._c_new_inputs.inc()
+                self._e_admit_rate.add(1)
                 # broadcast to the other fuzzers (ref manager.go:596-621)
                 wire = {"prog": params.get("prog"), "call": call,
                         "call_index": call_index,
@@ -264,14 +391,23 @@ class Manager:
         self._maybe_update_prios()
         return {}
 
+    def _record_rejected(self, n: int = 1) -> None:
+        self._c_rejected.inc(n)
+
+    def _record_admit_rate(self, n: int) -> None:
+        """Batch stat bookkeeping for the coalescer's drainer: one
+        counter bump + one EWMA fold per fused dispatch, keeping the
+        typed stat plane off the per-input hot path."""
+        self._c_new_inputs.inc(n)
+        self._e_admit_rate.add(n)
+
     def _record_admitted(self, p, row: int) -> None:
-        """Corpus/stat/broadcast bookkeeping for one admitted input.
-        Caller (the coalescer's drainer) holds _mu AND _admit_mu."""
+        """Corpus/broadcast bookkeeping for one admitted input (counts
+        are folded per batch by _record_admit_rate).  Caller (the
+        coalescer's drainer) holds _mu AND _admit_mu."""
         self.corpus[p.sig] = CorpusItem(
             data=p.data, call=p.call, call_index=p.call_index,
             corpus_row=row)
-        self.stats["manager new inputs"] = \
-            self.stats.get("manager new inputs", 0) + 1
         wire = {"prog": p.wire_prog, "call": p.call,
                 "call_index": p.call_index, "cover": p.wire_cover}
         for other, conn in self.fuzzers.items():
@@ -395,7 +531,7 @@ class Manager:
                 break
         with self._mu:
             self.crash_types[title] = self.crash_types.get(title, 0) + 1
-            self.stats["crashes"] = self.stats.get("crashes", 0) + 1
+        self._c_crashes.inc()
         log.logf(0, "vm crash: %s", title)
         return d
 
@@ -506,7 +642,8 @@ class Manager:
                 cmd = self.fuzzer_cmdline(index, addr)
                 handle = inst.run(cmd, timeout=VM_RUN_TIME)
                 outcome = monitor_execution(handle, VM_RUN_TIME,
-                                            ignores=suppressions)
+                                            ignores=suppressions,
+                                            outcomes=self._f_vm_outcomes)
                 handle.stop()
                 # shutdown kills the fuzzer: its EOF is not a crash
                 if outcome.crashed and not self._stop:
@@ -543,11 +680,23 @@ class Manager:
                  self.rpc_port, self.cfg.count, self.cfg.type,
                  len(self.candidates))
 
+    def persist_telemetry(self) -> None:
+        """One snapshot to workdir/telemetry.json(+.jsonl) — next to the
+        corpus, so bench and post-mortems read metric trajectories.
+        Folds the device stat vector into host cumulatives (int32
+        roll-over protection) via the engine-locked flush."""
+        try:
+            self.engine.telemetry_flush(reset=True)
+            expo.persist_snapshot(self.cfg.workdir, self.telemetry_snapshot())
+        except Exception as e:
+            log.logf(1, "telemetry persistence failed: %s", e)
+
     def run(self, duration: "float | None" = None) -> None:
         self.start()
         deadline = time.time() + duration if duration else None
         last_stats = time.time()
         last_minimize = time.time()
+        last_telemetry = time.time()
         try:
             while not self._stop:
                 time.sleep(1.0)
@@ -555,13 +704,16 @@ class Manager:
                     break
                 if time.time() - last_stats > 10.0:
                     last_stats = time.time()
-                    with self._mu:
-                        execs = self.stats.get("exec total", 0)
-                        crashes = self.stats.get("crashes", 0)
+                    execs = self.stats.get("exec total", 0)
+                    crashes = self.stats.get("crashes", 0)
                     log.logf(0, "executed %d programs, %d crashes, "
                              "corpus %d, cover %d",
                              execs, crashes, len(self.corpus),
                              int(self.engine.cover_counts().sum()))
+                if self.cfg.telemetry and \
+                        time.time() - last_telemetry > self.cfg.telemetry_interval:
+                    last_telemetry = time.time()
+                    self.persist_telemetry()
                 if time.time() - last_minimize > 300.0:
                     last_minimize = time.time()
                     self.minimize_corpus()
@@ -572,6 +724,8 @@ class Manager:
         self._stop = True
         if self.coalescer is not None:
             self.coalescer.stop()
+        if self.cfg.telemetry:
+            self.persist_telemetry()     # final post-mortem snapshot
         with self._mu:
             instances = list(self._instances.values())
         for inst in instances:

@@ -68,6 +68,72 @@ def main(argv=None) -> int:
         if r.returncode != 0:
             raise SystemExit("engine smoke failed")
 
+    def stats_lint():
+        # the stat plane is typed (telemetry/registry.py): new direct
+        # `self.stats[...]` mutations must go through the registry or
+        # the StatsView facade — reject them everywhere but telemetry/
+        import re
+
+        pat = re.compile(r"self\.stats\[")
+        bad: list[str] = []
+        pkg = os.path.join(root, "syzkaller_tpu")
+        targets = [os.path.join(root, "bench.py")]
+        for dirpath, _dirs, files in os.walk(pkg):
+            if os.path.basename(dirpath) == "telemetry":
+                continue
+            targets += [os.path.join(dirpath, f) for f in files
+                        if f.endswith(".py") and f != "presubmit.py"]
+        for path in targets:
+            with open(path, encoding="utf-8") as f:
+                for ln, line in enumerate(f, 1):
+                    if pat.search(line):
+                        bad.append(f"{os.path.relpath(path, root)}:{ln}")
+        if bad:
+            raise SystemExit(
+                "raw self.stats[...] access outside telemetry/ — use "
+                "typed registry metrics (telemetry/registry.py) or "
+                "StatsView.bump():\n  " + "\n  ".join(bad))
+
+    # a live manager must serve /metrics with the core series on every
+    # plane — the contract dashboards and bench scrape against.  Runs in
+    # a subprocess (like engine_smoke) so the presubmit process itself
+    # never initializes an accelerator runtime.
+    _TELEMETRY_SMOKE = r"""
+import tempfile, urllib.request
+from syzkaller_tpu.manager import html
+from syzkaller_tpu.manager.config import Config
+from syzkaller_tpu.manager.manager import Manager
+from syzkaller_tpu.telemetry import expo
+
+cfg = Config(workdir=tempfile.mkdtemp(prefix="syz-presubmit-"),
+             type="local", count=1, descriptions="probe.txt",
+             npcs=1 << 12, corpus_cap=64, http="")
+mgr = Manager(cfg)
+srv = html.serve(mgr, "127.0.0.1", 0)
+host, port = srv.server_address
+with urllib.request.urlopen(
+        "http://%s:%d/metrics" % (host, port), timeout=10) as resp:
+    assert resp.status == 200
+    series = expo.parse_prometheus_text(resp.read().decode())
+assert len(series) >= 20, "only %d series" % len(series)
+for must in ("syz_admission_inputs_total",
+             "syz_admission_new_inputs_total",
+             'syz_cover_dispatches_total{kind="dense"}',
+             "syz_exec_rate", "syz_crash_total",
+             'syz_rpc_requests_total{method="Manager.Poll"}',
+             "syz_corpus_size", "syz_uptime_seconds"):
+    assert must in series, "/metrics missing " + must
+srv.shutdown()
+mgr.stop()
+print("telemetry ok: %d series" % len(series))
+"""
+
+    def telemetry_smoke():
+        r = subprocess.run([sys.executable, "-c", _TELEMETRY_SMOKE],
+                           cwd=root, env=env)
+        if r.returncode != 0:
+            raise SystemExit("telemetry smoke failed")
+
     def bench_smoke():
         # seconds-scale CPU-only bench pass on tiny shapes: catches
         # bench.py import/shape regressions here instead of in the next
@@ -86,8 +152,10 @@ def main(argv=None) -> int:
 
     total = 0.0
     total += step("description tables", gen_tables)
+    total += step("stats lint", stats_lint)
     total += step("native executor build", build_executor)
     total += step("engine + multichip smoke", engine_smoke)
+    total += step("telemetry smoke", telemetry_smoke)
     total += step("bench smoke", bench_smoke)
     total += step("pytest", pytest_run)
     print(f"[presubmit] PASS in {total:.0f}s")

@@ -20,6 +20,7 @@ import json
 import socket
 import socketserver
 import threading
+import time
 from typing import Any, Callable
 
 
@@ -37,10 +38,16 @@ class RpcError(Exception):
 
 class RpcServer:
     """Threaded JSON-lines RPC server. Handlers: dict method -> fn(params)
-    -> result dict. One thread per connection (keep-alive, many calls)."""
+    -> result dict. One thread per connection (keep-alive, many calls).
+
+    `observer`, when set, is called as observer(method, seconds, params)
+    after every handled request — the telemetry tap for per-method
+    request counters/latency histograms and RPC trace spans (the
+    `trace` param rides inside `params` untouched)."""
 
     def __init__(self, host: str = "127.0.0.1", port: int = 0):
         self.handlers: dict[str, Callable[[dict], dict]] = {}
+        self.observer: "Callable[[str, float, dict], None] | None" = None
         outer = self
 
         class Handler(socketserver.StreamRequestHandler):
@@ -49,19 +56,29 @@ class RpcServer:
                     line = line.strip()
                     if not line:
                         continue
+                    method = ""
+                    params: dict = {}
+                    t0 = time.monotonic()
                     try:
                         req = json.loads(line)
                         method = req.get("method", "")
+                        params = req.get("params") or {}
                         fn = outer.handlers.get(method)
                         if fn is None:
                             resp = {"id": req.get("id"),
                                     "error": f"unknown method {method}"}
                         else:
                             resp = {"id": req.get("id"),
-                                    "result": fn(req.get("params") or {})}
+                                    "result": fn(params)}
                     except Exception as e:  # handler bug -> error reply
                         resp = {"id": req.get("id") if isinstance(req, dict) else None,
                                 "error": f"{type(e).__name__}: {e}"}
+                    obs = outer.observer
+                    if obs is not None:
+                        try:
+                            obs(method, time.monotonic() - t0, params)
+                        except Exception:
+                            pass   # telemetry must never break the wire
                     try:
                         self.wfile.write(json.dumps(resp).encode() + b"\n")
                         self.wfile.flush()
@@ -112,7 +129,24 @@ class RpcClient:
         self._sock = s
         self._file = s.makefile("rwb")
 
-    def call(self, method: str, params: "dict | None" = None) -> dict:
+    def call(self, method: str, params: "dict | None" = None,
+             span=None) -> dict:
+        """One RPC round trip.  `span` (a telemetry.trace.SpanContext)
+        is injected into params as the `trace` field and gets an
+        `rpc:<method>` hop with the client-observed duration — this is
+        how trace context propagates Connect → Poll → NewInput."""
+        if span is not None:
+            params = dict(params or {})
+            span.sent_at = time.time()
+            params["trace"] = span.to_wire()
+        t0 = time.monotonic()
+        try:
+            return self._call_locked(method, params)
+        finally:
+            if span is not None:
+                span.add_hop(f"rpc:{method}", time.monotonic() - t0)
+
+    def _call_locked(self, method: str, params: "dict | None") -> dict:
         with self._mu:
             for attempt in (0, 1):
                 if self._sock is None:

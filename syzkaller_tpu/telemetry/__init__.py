@@ -1,0 +1,26 @@
+"""Device-resident telemetry subsystem.
+
+Four parts (see README "Telemetry"):
+
+- registry:  typed Counter / Gauge / Histogram / EWMA-rate metrics with
+             label support — the stat plane the reference keeps as
+             first-class (manager.go stats aggregation) rebuilt typed.
+- device:    a fixed-slot int32 stat vector living on the cover
+             engine's device/mesh, bumped inside the fused dispatches,
+             flushed in one transfer.
+- trace:     span contexts propagated through RPC request params so one
+             admitted input is traceable VM→fuzzer→coalescer→device.
+- expo:      /metrics Prometheus text + /telemetry JSON + periodic
+             snapshot persistence next to the corpus.
+"""
+
+from syzkaller_tpu.telemetry.device import DeviceStats
+from syzkaller_tpu.telemetry.registry import (
+    Counter, EwmaRate, Family, Gauge, Histogram, Registry, StatsView,
+    default_registry)
+from syzkaller_tpu.telemetry.trace import SpanContext, Tracer
+
+__all__ = [
+    "Counter", "DeviceStats", "EwmaRate", "Family", "Gauge", "Histogram",
+    "Registry", "SpanContext", "StatsView", "Tracer", "default_registry",
+]

@@ -1,0 +1,236 @@
+"""Device-resident stat accumulators: a fixed-slot int32 vector that
+lives on the same device (and mesh sharding — replicated spec) as the
+coverage engine's bitmaps.
+
+Hot-loop counters must not add host↔device round trips: the cover
+engine's fused dispatches (update / sparse_update / admission
+gate+merge) bump their slots with `.at[].add()` INSIDE the already-jitted
+step.  Host-side observations split by rate: rare events (fallback
+decisions, via `inc()`) stage into a pending buffer that rides the next
+dispatch as a tiny extra operand, while the per-input latency
+histograms (`observe()`/`observe_batch()`) fold straight into the host
+int64 cumulatives — they are host-measured values, and shipping them
+through the device would re-dirty the pending buffer every batch and
+cost one small host→device transfer per dispatch (measured ~5% off the
+admission rate).  When nothing is pending the dispatches are handed a
+cached device-resident zero vector, so the steady-state fast path
+transfers NOTHING beyond what the dispatch already moved.  `flush()`
+reads the whole stat vector back in ONE transfer.
+
+Slot layout is static: scalar counters first, then three log2-bucketed
+latency histograms (admission, exec, choice-draw), each NBUCKETS slots.
+Values are int32 on device; `flush(reset=True)` folds them into host
+int64 cumulative totals and zeroes the vector, so periodic flushing
+(the manager's snapshot persistence loop) keeps the device slots far
+from the int32 roll-over.
+"""
+
+from __future__ import annotations
+
+import threading
+
+import numpy as np
+
+from syzkaller_tpu.telemetry.registry import log2_bucket
+
+NBUCKETS = 24
+HIST_BASE = 1e-6          # first bucket: <= 1µs; last: > ~4s (2^22 µs)
+
+# (slot key, exposition series name, labels) — the slot key is what the
+# engine's jit closures reference; the series name is what /metrics
+# renders.  Append-only: tests and dashboards key on these names.
+SCALAR_SLOTS = [
+    ("dense_batches", "syz_cover_dispatches_total", {"kind": "dense"}),
+    ("dense_rows", "syz_cover_rows_total", {"kind": "dense"}),
+    ("dense_newsig", "syz_cover_newsig_total", {"kind": "dense"}),
+    ("sparse_batches", "syz_cover_dispatches_total", {"kind": "sparse"}),
+    ("sparse_rows", "syz_cover_rows_total", {"kind": "sparse"}),
+    ("sparse_newsig", "syz_cover_newsig_total", {"kind": "sparse"}),
+    ("sparse_fallback", "syz_cover_sparse_fallback_total", {}),
+    ("admit_batches", "syz_admission_dispatches_total", {}),
+    ("admit_inputs", "syz_admission_gate_inputs_total", {}),
+    ("admit_admitted", "syz_admission_gate_admitted_total", {}),
+    ("admit_draws", "syz_choice_draws_total", {"source": "admission"}),
+]
+
+HIST_SLOTS = [
+    ("admission_latency", "syz_admission_latency_seconds"),
+    ("exec_latency", "syz_exec_latency_seconds"),
+    ("choice_draw_latency", "syz_choice_draw_latency_seconds"),
+]
+
+
+def _nslots() -> int:
+    n = len(SCALAR_SLOTS) + len(HIST_SLOTS) * NBUCKETS
+    return -(-n // 32) * 32          # pad for tidy device layout
+
+
+class DeviceStats:
+    """The stat vector + its host-side pending/overflow bookkeeping.
+
+    Engine contract (cover/engine.py): under the engine's state lock,
+    each instrumented dispatch calls `take_pending_device()` for the
+    ride-along increments, passes `self.vec` as the svec argument
+    (NOT donated — flush may be concurrently reading it), and stores the
+    returned updated vector back via `commit()`.
+    """
+
+    def __init__(self):
+        self.nslots = _nslots()
+        self._slot: dict[str, int] = {}
+        for i, (key, _name, _labels) in enumerate(SCALAR_SLOTS):
+            self._slot[key] = i
+        self._hist_base: dict[str, int] = {}
+        off = len(SCALAR_SLOTS)
+        for key, _name in HIST_SLOTS:
+            self._hist_base[key] = off
+            off += NBUCKETS
+        self._mu = threading.Lock()
+        self._pending = np.zeros((self.nslots,), np.int64)
+        self._dirty = False
+        self._cum = np.zeros((self.nslots,), np.int64)
+        self._hist_sum = {key: 0.0 for key, _ in HIST_SLOTS}
+        self._sharding = None
+        import jax.numpy as jnp
+        self.vec = jnp.zeros((self.nslots,), jnp.int32)
+        # the clean-pending fast-path operand: handed to dispatches when
+        # nothing is staged, so no per-dispatch transfer happens
+        self._zero = jnp.zeros((self.nslots,), jnp.int32)
+
+    # -- slot addressing (static ints for jit closures) --------------------
+
+    def slot(self, key: str) -> int:
+        return self._slot[key]
+
+    def hist_base(self, key: str) -> int:
+        return self._hist_base[key]
+
+    # -- host-side recording ----------------------------------------------
+
+    def inc(self, key: str, n: int = 1) -> None:
+        """Host-known count (e.g. a fallback decision): staged into the
+        pending buffer and folded into the vector by the next dispatch."""
+        with self._mu:
+            self._pending[self._slot[key]] += n
+            self._dirty = True
+
+    def observe(self, key: str, seconds: float) -> None:
+        """Record one latency observation into a log2 histogram (host
+        cumulatives — see module docstring for why these skip the
+        device)."""
+        b = log2_bucket(seconds, HIST_BASE, NBUCKETS)
+        with self._mu:
+            self._cum[self._hist_base[key] + b] += 1
+            self._hist_sum[key] += seconds
+
+    def observe_batch(self, key: str, seconds_list) -> None:
+        """Batch form for hot loops (the admission coalescer observes
+        one latency per coalesced input): bucket outside the lock, one
+        lock acquisition for the whole batch."""
+        if not seconds_list:
+            return
+        arr = np.asarray(seconds_list, np.float64)
+        # vectorized log2_bucket: x <= base lands at 0 via the clip
+        with np.errstate(divide="ignore"):
+            idx = np.ceil(np.log2(np.maximum(arr, 1e-300) / HIST_BASE))
+        counts = np.bincount(
+            np.clip(idx, 0, NBUCKETS - 1).astype(np.int64),
+            minlength=NBUCKETS)
+        base = self._hist_base[key]
+        with self._mu:
+            self._cum[base: base + NBUCKETS] += counts
+            self._hist_sum[key] += float(arr.sum())
+
+    # -- engine-side handoff ----------------------------------------------
+
+    def take_pending_device(self):
+        """Pending host increments as a device-bound int32 array; the
+        caller adds it to svec inside its dispatch.  Increments taken
+        here are committed to the vector by that dispatch — a dispatch
+        failure loses them, which telemetry tolerates.  The common
+        nothing-pending case returns the cached device zero vector:
+        no transfer at all."""
+        import jax.numpy as jnp
+        with self._mu:
+            if not self._dirty:
+                return self._zero
+            arr = self._pending.astype(np.int32)
+            self._pending[:] = 0
+            self._dirty = False
+        return jnp.asarray(arr)
+
+    def commit(self, new_vec) -> None:
+        self.vec = new_vec
+
+    def device_put(self, mesh=None) -> None:
+        """Place the vector on the engine's device/mesh (replicated over
+        a PC-axis mesh: every chip holds the same tiny vector, bumps are
+        elementwise so no cross-chip traffic is added)."""
+        import jax
+        if mesh is not None:
+            from jax.sharding import NamedSharding, PartitionSpec as P
+            self._sharding = NamedSharding(mesh, P())
+            self.vec = jax.device_put(self.vec, self._sharding)
+            self._zero = jax.device_put(self._zero, self._sharding)
+
+    # -- readback ----------------------------------------------------------
+
+    def values(self) -> np.ndarray:
+        """(nslots,) int64 totals: cumulative + device vector (ONE
+        transfer) + not-yet-folded pending.  Safe without the engine
+        lock: the vector is never donated."""
+        dev = np.asarray(self.vec).astype(np.int64)
+        with self._mu:
+            return self._cum + dev + self._pending
+
+    def flush(self, reset: bool = False) -> np.ndarray:
+        """Totals, optionally folding the device vector into the host
+        int64 cumulative and zeroing the device slots (int32 roll-over
+        protection).  reset=True must be called with the engine's state
+        lock held (engine.telemetry_flush) — a concurrent dispatch would
+        otherwise resurrect pre-reset counts."""
+        import jax.numpy as jnp
+        dev = np.asarray(self.vec).astype(np.int64)
+        with self._mu:
+            out = self._cum + dev + self._pending
+            if reset:
+                self._cum = self._cum + dev
+                vec = jnp.zeros((self.nslots,), jnp.int32)
+                if self._sharding is not None:
+                    import jax
+                    vec = jax.device_put(vec, self._sharding)
+                self.vec = vec
+        return out
+
+    # -- exposition --------------------------------------------------------
+
+    def series(self):
+        """Yield (name, kind, labels, value) for every exposition series:
+        scalar counters plus histogram dicts shaped like
+        registry.Histogram.value."""
+        vals = self.values()
+        for key, name, labels in SCALAR_SLOTS:
+            yield name, "counter", labels, int(vals[self._slot[key]])
+        with self._mu:
+            sums = dict(self._hist_sum)
+        for key, name in HIST_SLOTS:
+            base = self._hist_base[key]
+            buckets = [int(x) for x in vals[base: base + NBUCKETS]]
+            yield name, "histogram", {}, {
+                "buckets": buckets, "sum": sums[key],
+                "count": int(sum(buckets))}
+
+    def snapshot(self) -> dict:
+        out: dict = {}
+        for name, _kind, labels, value in self.series():
+            if labels:
+                k = ",".join(f"{a}={b}" for a, b in sorted(labels.items()))
+                out.setdefault(name, {})[k] = value
+            else:
+                out[name] = value
+        return out
+
+    def hist_upper_bounds(self) -> "list[float]":
+        import math
+        return [HIST_BASE * (1 << i) for i in range(NBUCKETS - 1)] \
+            + [math.inf]

@@ -1,0 +1,171 @@
+"""Telemetry exposition: Prometheus text format, JSON snapshots, and
+periodic snapshot persistence.
+
+`/metrics` (manager/html.py) serves `prometheus_text(...)` — the 0.0.4
+text format Prometheus scrapes; `/telemetry` serves `snapshot_json` —
+the same data plus recent trace spans, machine-readable for bench.py
+and tests.  `persist_snapshot` appends one JSON line per interval next
+to the corpus so post-mortems can read metric trajectories.
+"""
+
+from __future__ import annotations
+
+import json
+import math
+import os
+import time
+
+from syzkaller_tpu.telemetry.device import DeviceStats
+from syzkaller_tpu.telemetry.registry import Registry
+from syzkaller_tpu.telemetry.trace import Tracer
+
+
+def _fmt_labels(labels: dict) -> str:
+    if not labels:
+        return ""
+    inner = ",".join(f'{k}="{_escape(str(v))}"'
+                     for k, v in sorted(labels.items()))
+    return "{" + inner + "}"
+
+
+def _escape(v: str) -> str:
+    return v.replace("\\", "\\\\").replace('"', '\\"').replace("\n", "\\n")
+
+
+def _fmt_value(v) -> str:
+    if isinstance(v, float):
+        if math.isinf(v):
+            return "+Inf" if v > 0 else "-Inf"
+        if math.isnan(v):
+            return "NaN"
+        return repr(v)
+    return str(int(v))
+
+
+def _fmt_bound(b: float) -> str:
+    if math.isinf(b):
+        return "+Inf"
+    return repr(float(b))
+
+
+def _hist_lines(name: str, labels: dict, value: dict,
+                bounds: "list[float]") -> "list[str]":
+    out = []
+    cum = 0
+    for count, bound in zip(value["buckets"], bounds):
+        cum += count
+        lb = dict(labels)
+        lb["le"] = _fmt_bound(bound)
+        out.append(f"{name}_bucket{_fmt_labels(lb)} {cum}")
+    out.append(f"{name}_sum{_fmt_labels(labels)} {_fmt_value(float(value['sum']))}")
+    out.append(f"{name}_count{_fmt_labels(labels)} {value['count']}")
+    return out
+
+
+def prometheus_text(registries: "list[Registry]",
+                    device_stats: "DeviceStats | None" = None) -> str:
+    """Render every series in `registries` (plus the device stat vector)
+    as Prometheus 0.0.4 text exposition."""
+    lines: list[str] = []
+    seen_header: set[str] = set()
+
+    def header(name: str, kind: str, help_: str) -> None:
+        if name in seen_header:
+            return
+        seen_header.add(name)
+        if help_:
+            lines.append(f"# HELP {name} {_escape(help_)}")
+        lines.append(f"# TYPE {name} {kind}")
+
+    for reg in registries:
+        for name, kind, help_, series in reg.collect():
+            # EWMA rates expose as gauges; registry reports kind per-class
+            header(name, "gauge" if kind == "gauge" else kind, help_)
+            for s in series:
+                v = s.value
+                if kind == "histogram":
+                    lines.extend(_hist_lines(name, s.labels, v,
+                                             s.upper_bounds()))
+                else:
+                    lines.append(
+                        f"{name}{_fmt_labels(s.labels)} {_fmt_value(v)}")
+    if device_stats is not None:
+        bounds = device_stats.hist_upper_bounds()
+        for name, kind, labels, value in device_stats.series():
+            header(name, kind, "device-resident accumulator "
+                   "(telemetry/device.py stat vector)")
+            if kind == "histogram":
+                lines.extend(_hist_lines(name, labels, value, bounds))
+            else:
+                lines.append(f"{name}{_fmt_labels(labels)} "
+                             f"{_fmt_value(value)}")
+    return "\n".join(lines) + "\n"
+
+
+def snapshot(registries: "list[Registry]",
+             device_stats: "DeviceStats | None" = None,
+             tracer: "Tracer | None" = None,
+             traces: int = 16) -> dict:
+    """JSON-ready snapshot of every registry, the device stat vector,
+    and the most recent completed trace spans."""
+    out: dict = {"ts": time.time(), "metrics": {}}
+    for reg in registries:
+        out["metrics"].update(reg.snapshot())
+    if device_stats is not None:
+        out["device"] = device_stats.snapshot()
+    if tracer is not None:
+        out["traces"] = tracer.snapshot(traces)
+        out["traces_recorded_total"] = tracer.recorded_total
+    return out
+
+
+def parse_prometheus_text(text: str) -> dict:
+    """Minimal exposition parser (tests + presubmit smoke): returns
+    {series-line-key: float} keyed by `name{labels}`."""
+    out: dict[str, float] = {}
+    for line in text.splitlines():
+        line = line.strip()
+        if not line or line.startswith("#"):
+            continue
+        key, _, val = line.rpartition(" ")
+        if not key:
+            continue
+        if val == "+Inf":
+            out[key] = math.inf
+        elif val == "-Inf":
+            out[key] = -math.inf
+        else:
+            try:
+                out[key] = float(val)
+            except ValueError:
+                continue
+    return out
+
+
+def persist_snapshot(workdir: str, snap: dict,
+                     history_cap_bytes: int = 16 << 20) -> str:
+    """Write the latest snapshot to <workdir>/telemetry.json and append
+    it as one line to <workdir>/telemetry.jsonl (the trajectory file
+    bench.py and post-mortems read).  The history file is truncated from
+    the FRONT when it outgrows the cap — recent trajectory matters more
+    than ancient history."""
+    latest = os.path.join(workdir, "telemetry.json")
+    history = os.path.join(workdir, "telemetry.jsonl")
+    line = json.dumps(snap, default=str)
+    tmp = latest + ".tmp"
+    with open(tmp, "w") as f:
+        f.write(line + "\n")
+    os.replace(tmp, latest)
+    with open(history, "a") as f:
+        f.write(line + "\n")
+    try:
+        if os.path.getsize(history) > history_cap_bytes:
+            with open(history, "rb") as f:
+                f.seek(-history_cap_bytes // 2, os.SEEK_END)
+                tail = f.read()
+            tail = tail[tail.find(b"\n") + 1:]
+            with open(history, "wb") as f:
+                f.write(tail)
+    except OSError:
+        pass
+    return latest

@@ -1,0 +1,391 @@
+"""Typed metrics registry: Counter / Gauge / Histogram / EWMA rate.
+
+The reference syzkaller treats stats as a first-class plane — fuzzers
+ship counter deltas on every Poll and the manager aggregates and renders
+them (manager/manager.go stats aggregation, manager/html.go).  The port
+degenerated this into ad-hoc `dict[str, int]` string-key increments;
+this registry replaces them with typed, labeled series that one
+`Registry` owns per process component (manager, fuzzer, hub), rendered
+by telemetry/expo.py as Prometheus text and JSON snapshots.
+
+Naming scheme (documented in README): `syz_<plane>_<what>_<unit>`,
+e.g. `syz_admission_inputs_total`, `syz_rpc_request_seconds`.  Label
+sets are fixed per family; children are created on first `labels()`
+call, so exposition order is deterministic (insertion order).
+
+Thread safety: one lock per Registry covers all mutation — increments
+are a dict lookup + integer add, far off any hot path (the hot-loop
+counters live in telemetry/device.py's device-resident vector).
+"""
+
+from __future__ import annotations
+
+import math
+import threading
+import time
+from typing import Callable, Iterator, MutableMapping
+
+
+def _label_key(labels: dict) -> tuple:
+    return tuple(sorted(labels.items()))
+
+
+class Counter:
+    """Monotonic int64 counter with delta-draining for Poll shipping."""
+
+    kind = "counter"
+
+    def __init__(self, name: str, labels: "dict | None" = None,
+                 lock: "threading.Lock | None" = None):
+        self.name = name
+        self.labels = dict(labels or {})
+        self._lock = lock or threading.Lock()
+        self._value = 0
+        self._shipped = 0            # drain() watermark
+
+    def inc(self, n: int = 1) -> None:
+        with self._lock:
+            self._value += int(n)
+
+    @property
+    def value(self) -> int:
+        with self._lock:
+            return self._value
+
+    def drain(self) -> int:
+        """Value accumulated since the last drain — the Poll wire ships
+        deltas, not absolutes (ref fuzzer.go:246-252 stat reset)."""
+        with self._lock:
+            d = self._value - self._shipped
+            self._shipped = self._value
+            return d
+
+
+class Gauge:
+    """Point-in-time value; optionally backed by a callback evaluated at
+    collection time (uptime, corpus size — state someone else owns)."""
+
+    kind = "gauge"
+
+    def __init__(self, name: str, labels: "dict | None" = None,
+                 lock: "threading.Lock | None" = None,
+                 fn: "Callable[[], float] | None" = None):
+        self.name = name
+        self.labels = dict(labels or {})
+        self._lock = lock or threading.Lock()
+        self._value = 0.0
+        self._fn = fn
+
+    def set(self, v: float) -> None:
+        with self._lock:
+            self._value = float(v)
+
+    def set_function(self, fn: "Callable[[], float]") -> None:
+        self._fn = fn
+
+    @property
+    def value(self) -> float:
+        if self._fn is not None:
+            try:
+                return float(self._fn())
+            except Exception:
+                return float("nan")
+        with self._lock:
+            return self._value
+
+
+class Histogram:
+    """Log2-bucketed histogram: bucket i counts observations in
+    (base*2^(i-1), base*2^i]; the last bucket is +Inf.  Matches the
+    device accumulator's bucketing (telemetry/device.py) so host- and
+    device-side latency series render identically."""
+
+    kind = "histogram"
+
+    def __init__(self, name: str, labels: "dict | None" = None,
+                 lock: "threading.Lock | None" = None,
+                 base: float = 1e-6, nbuckets: int = 24):
+        self.name = name
+        self.labels = dict(labels or {})
+        self._lock = lock or threading.Lock()
+        self.base = base
+        self.nbuckets = nbuckets
+        self.buckets = [0] * nbuckets
+        self.sum = 0.0
+        self.count = 0
+
+    def bucket_index(self, x: float) -> int:
+        return log2_bucket(x, self.base, self.nbuckets)
+
+    def upper_bounds(self) -> "list[float]":
+        # bucket i upper bound base*2^i; last is +inf
+        return [self.base * (1 << i) for i in range(self.nbuckets - 1)] \
+            + [math.inf]
+
+    def observe(self, x: float) -> None:
+        b = self.bucket_index(x)
+        with self._lock:
+            self.buckets[b] += 1
+            self.sum += x
+            self.count += 1
+
+    @property
+    def value(self) -> dict:
+        with self._lock:
+            return {"buckets": list(self.buckets), "sum": self.sum,
+                    "count": self.count}
+
+
+def log2_bucket(x: float, base: float, nbuckets: int) -> int:
+    """Shared host/device log2 bucketing rule: index of the first bound
+    base*2^i that is >= x (0 for x <= base, last bucket saturates)."""
+    if x <= base:
+        return 0
+    return min(nbuckets - 1, max(0, math.ceil(math.log2(x / base))))
+
+
+class EwmaRate:
+    """Exponentially-weighted events/sec estimate (tau-second horizon).
+
+    `add(n)` folds n events over the elapsed interval; `value` decays
+    toward zero during silence so a stalled plane reads as stalled
+    instead of freezing at its last good rate.  `now` is injectable for
+    deterministic tests."""
+
+    kind = "gauge"          # exposed as a gauge series
+
+    def __init__(self, name: str, labels: "dict | None" = None,
+                 lock: "threading.Lock | None" = None, tau: float = 60.0):
+        self.name = name
+        self.labels = dict(labels or {})
+        self._lock = lock or threading.Lock()
+        self.tau = tau
+        self._rate = 0.0
+        self._last: "float | None" = None
+
+    def add(self, n: int = 1, now: "float | None" = None) -> None:
+        now = time.monotonic() if now is None else now
+        with self._lock:
+            if self._last is None:
+                self._last = now
+                return          # first sample has no interval to rate over
+            dt = max(now - self._last, 1e-9)
+            alpha = 1.0 - math.exp(-dt / self.tau)
+            self._rate = alpha * (n / dt) + (1.0 - alpha) * self._rate
+            self._last = now
+
+    def rate(self, now: "float | None" = None) -> float:
+        now = time.monotonic() if now is None else now
+        with self._lock:
+            if self._last is None:
+                return 0.0
+            # decay for silence beyond the normal sampling cadence
+            idle = max(0.0, now - self._last)
+            return self._rate * math.exp(-idle / self.tau)
+
+    @property
+    def value(self) -> float:
+        return self.rate()
+
+
+class Family:
+    """A labeled metric family: `labels(vm="vm0")` returns the child
+    series, created on first use.  Children share the family lock."""
+
+    def __init__(self, name: str, cls, labelnames: "tuple[str, ...]",
+                 lock: threading.Lock, **kwargs):
+        self.name = name
+        self.cls = cls
+        self.kind = cls.kind
+        self.labelnames = tuple(labelnames)
+        self._lock = lock
+        self._kwargs = kwargs
+        self._children: dict[tuple, object] = {}
+
+    def labels(self, **kv):
+        if set(kv) != set(self.labelnames):
+            raise ValueError(
+                f"{self.name}: labels {sorted(kv)} != {sorted(self.labelnames)}")
+        key = _label_key(kv)
+        with self._lock:
+            child = self._children.get(key)
+            if child is None:
+                child = self.cls(self.name, labels=kv, lock=self._lock,
+                                 **self._kwargs)
+                self._children[key] = child
+            return child
+
+    def children(self) -> "list":
+        with self._lock:
+            return list(self._children.values())
+
+
+class Registry:
+    """Owns a component's metric families; collect() yields every live
+    series for exposition, snapshot() a JSON-ready dict."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._metrics: dict[str, object] = {}     # name -> metric | Family
+        self._help: dict[str, str] = {}
+
+    def _register(self, name: str, help_: str, factory):
+        with self._lock:
+            existing = self._metrics.get(name)
+            if existing is not None:
+                return existing
+            m = factory()
+            self._metrics[name] = m
+            self._help[name] = help_
+            return m
+
+    def counter(self, name: str, help: str = "",
+                labels: "tuple[str, ...]" = ()) -> "Counter | Family":
+        if labels:
+            return self._register(name, help, lambda: Family(
+                name, Counter, labels, self._lock))
+        return self._register(name, help, lambda: Counter(name,
+                                                          lock=self._lock))
+
+    def gauge(self, name: str, help: str = "",
+              labels: "tuple[str, ...]" = (),
+              fn: "Callable[[], float] | None" = None) -> "Gauge | Family":
+        if labels:
+            return self._register(name, help, lambda: Family(
+                name, Gauge, labels, self._lock))
+        return self._register(name, help, lambda: Gauge(name,
+                                                        lock=self._lock,
+                                                        fn=fn))
+
+    def histogram(self, name: str, help: str = "",
+                  labels: "tuple[str, ...]" = (), base: float = 1e-6,
+                  nbuckets: int = 24) -> "Histogram | Family":
+        if labels:
+            return self._register(name, help, lambda: Family(
+                name, Histogram, labels, self._lock, base=base,
+                nbuckets=nbuckets))
+        return self._register(name, help, lambda: Histogram(
+            name, lock=self._lock, base=base, nbuckets=nbuckets))
+
+    def ewma(self, name: str, help: str = "",
+             labels: "tuple[str, ...]" = (),
+             tau: float = 60.0) -> "EwmaRate | Family":
+        if labels:
+            return self._register(name, help, lambda: Family(
+                name, EwmaRate, labels, self._lock, tau=tau))
+        return self._register(name, help, lambda: EwmaRate(
+            name, lock=self._lock, tau=tau))
+
+    def collect(self):
+        """Yield (name, kind, help, [series…]) per family in
+        registration order; series are the leaf metric objects."""
+        with self._lock:
+            entries = list(self._metrics.items())
+            helps = dict(self._help)
+        for name, m in entries:
+            if isinstance(m, Family):
+                yield name, m.kind, helps.get(name, ""), m.children()
+            else:
+                yield name, m.kind, helps.get(name, ""), [m]
+
+    def snapshot(self) -> dict:
+        """JSON-ready {name: value | {label-string: value}}."""
+        out: dict = {}
+        for name, kind, _help, series in self.collect():
+            if len(series) == 1 and not series[0].labels:
+                out[name] = series[0].value
+            else:
+                out[name] = {
+                    ",".join(f"{k}={v}" for k, v in sorted(s.labels.items())):
+                    s.value for s in series}
+        return out
+
+
+# The process-default registry: free functions (vm/monitor, host probes)
+# record here unless handed a specific one; the owning component (the
+# manager) exposes it next to its own.
+DEFAULT = Registry()
+
+
+def default_registry() -> Registry:
+    return DEFAULT
+
+
+class StatsView(MutableMapping):
+    """The manager's legacy `dict[str, int]` stats facade over a
+    Registry.  Reads (`.get`, `dict(view)`, iteration) keep working for
+    the Poll wire payload and manager/html.py; writes route through
+    typed counters.  Known legacy keys alias first-class series
+    (`aliases`); unknown keys (fuzzer-shipped stat names) land in the
+    `fallback` labeled family under their own label.
+
+    Direct `view[k] = …` mutation is legal ONLY here and in telemetry/
+    — presubmit lints the rest of the tree for raw `self.stats[`
+    mutations.
+    """
+
+    def __init__(self, registry: Registry, aliases: "dict | None" = None,
+                 fallback_name: str = "syz_stat_total",
+                 fallback_label: str = "name"):
+        self._registry = registry
+        self._aliases: dict[str, Counter] = dict(aliases or {})
+        self._fallback = registry.counter(
+            fallback_name, "legacy stat-plane counters not yet promoted "
+            "to first-class series", labels=(fallback_label,))
+        self._fallback_label = fallback_label
+        self._mu = threading.Lock()
+        self._touched: dict[str, Counter] = {}
+
+    def _counter(self, key: str) -> Counter:
+        c = self._aliases.get(key)
+        if c is None:
+            c = self._fallback.labels(**{self._fallback_label: key})
+        with self._mu:
+            self._touched.setdefault(key, c)
+        return c
+
+    def bump(self, key: str, n: int = 1) -> None:
+        self._counter(key).inc(n)
+
+    # -- Mapping protocol --------------------------------------------------
+
+    def __getitem__(self, key: str) -> int:
+        with self._mu:
+            c = self._touched.get(key)
+        if c is None:
+            c = self._aliases.get(key)
+        if c is None:
+            raise KeyError(key)
+        return int(c.value)
+
+    def __setitem__(self, key: str, value: int) -> None:
+        # legacy read-modify-write increments arrive as absolute values;
+        # translate to a delta against the current counter state
+        c = self._counter(key)
+        delta = int(value) - c.value
+        if delta < 0:
+            raise ValueError(
+                f"stats[{key!r}]: counters are monotonic (got {value} "
+                f"< {c.value}); use a Gauge for resettable values")
+        c.inc(delta)
+
+    def __delitem__(self, key: str) -> None:
+        raise TypeError("stats entries cannot be deleted")
+
+    def __iter__(self) -> Iterator[str]:
+        with self._mu:
+            keys = set(self._touched)
+        keys.update(self._aliases)
+        return iter(sorted(keys))
+
+    def __len__(self) -> int:
+        with self._mu:
+            keys = set(self._touched)
+        keys.update(self._aliases)
+        return len(keys)
+
+    def get(self, key: str, default=None):
+        try:
+            return self[key]
+        except KeyError:
+            return default

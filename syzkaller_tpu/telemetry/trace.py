@@ -1,0 +1,135 @@
+"""Lightweight RPC trace spans: one admitted input traced
+VM → fuzzer → coalescer → device dispatch with per-hop durations.
+
+A `SpanContext` is a trace id plus an ordered list of completed hops;
+it rides RPC request params as a plain dict (`to_wire`/`from_wire`), so
+the JSON-lines wire plane (rpc.py) carries it with zero protocol
+changes — absent on old peers, ignored by old servers.  Completed
+traces land in a `Tracer` ring buffer, dumpable via the manager's
+`/telemetry` endpoint and the periodic snapshot file.
+
+Clock note: hop durations are measured with a monotonic clock on
+whichever host runs the hop, so per-hop durations are exact; the
+cross-host `rpc transit` hop uses wall clocks on both ends and is only
+meaningful when peers share a clock (same machine or NTP-synced fleet)
+— it is labeled `approx` on the wire for that reason.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from contextlib import contextmanager
+from dataclasses import dataclass, field
+
+
+def _new_id() -> str:
+    return os.urandom(8).hex()
+
+
+@dataclass
+class Hop:
+    name: str
+    start: float                  # unix wall time (cross-host alignment)
+    dur: float                    # seconds, monotonic-measured
+
+    def to_wire(self) -> dict:
+        return {"name": self.name, "start": self.start,
+                "dur_us": int(self.dur * 1e6)}
+
+
+@dataclass
+class SpanContext:
+    trace_id: str = field(default_factory=_new_id)
+    origin: str = ""              # e.g. the fuzzer/VM name
+    hops: "list[Hop]" = field(default_factory=list)
+    sent_at: float = 0.0          # stamped by the RPC client at send
+
+    def add_hop(self, name: str, dur: float,
+                start: "float | None" = None) -> None:
+        self.hops.append(Hop(name=name, dur=float(dur),
+                             start=time.time() if start is None else start))
+
+    @contextmanager
+    def span(self, name: str):
+        """Time a code block as one hop."""
+        t0 = time.monotonic()
+        start = time.time()
+        try:
+            yield self
+        finally:
+            self.hops.append(Hop(name=name, start=start,
+                                 dur=time.monotonic() - t0))
+
+    def to_wire(self) -> dict:
+        return {"trace_id": self.trace_id, "origin": self.origin,
+                "sent_at": self.sent_at,
+                "hops": [h.to_wire() for h in self.hops]}
+
+    @classmethod
+    def from_wire(cls, d) -> "SpanContext | None":
+        if not isinstance(d, dict) or not d.get("trace_id"):
+            return None
+        ctx = cls(trace_id=str(d["trace_id"]),
+                  origin=str(d.get("origin", "")),
+                  sent_at=float(d.get("sent_at", 0.0)))
+        for h in d.get("hops", []):
+            try:
+                ctx.hops.append(Hop(name=str(h["name"]),
+                                    start=float(h.get("start", 0.0)),
+                                    dur=float(h.get("dur_us", 0)) / 1e6))
+            except (KeyError, TypeError, ValueError):
+                continue
+        return ctx
+
+    def mark_transit(self) -> None:
+        """Record the client-send → server-receive gap as an approximate
+        hop (wall clocks on both ends; see module docstring)."""
+        if self.sent_at > 0:
+            self.add_hop("rpc transit (approx)",
+                         max(0.0, time.time() - self.sent_at),
+                         start=self.sent_at)
+
+
+class Tracer:
+    """Ring buffer of completed traces + a factory for new contexts."""
+
+    def __init__(self, capacity: int = 256, name: str = ""):
+        self.name = name
+        self.capacity = capacity
+        self._mu = threading.Lock()
+        self._ring: "list[dict]" = []
+        self._next = 0
+        self.recorded_total = 0
+
+    def new_trace(self, origin: str = "") -> SpanContext:
+        return SpanContext(origin=origin or self.name)
+
+    def record(self, ctx: "SpanContext | None", final_hop: str = "",
+               dur: float = 0.0) -> None:
+        """Finalize a trace into the ring (optionally appending one last
+        hop first)."""
+        if ctx is None:
+            return
+        if final_hop:
+            ctx.add_hop(final_hop, dur)
+        entry = ctx.to_wire()
+        entry["total_us"] = sum(h["dur_us"] for h in entry["hops"])
+        with self._mu:
+            if len(self._ring) < self.capacity:
+                self._ring.append(entry)
+            else:
+                self._ring[self._next % self.capacity] = entry
+            self._next += 1
+            self.recorded_total += 1
+
+    def snapshot(self, n: int = 32) -> "list[dict]":
+        """Most recent completed traces, newest last."""
+        with self._mu:
+            if len(self._ring) < self.capacity:
+                items = list(self._ring)
+            else:
+                cut = self._next % self.capacity
+                items = self._ring[cut:] + self._ring[:cut]
+        return items[-n:]

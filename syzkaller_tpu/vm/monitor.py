@@ -32,9 +32,41 @@ class Outcome:
     timed_out: bool = False
 
 
+def _classify(outcome: Outcome) -> str:
+    """Telemetry label for a run outcome (stable, low-cardinality)."""
+    if outcome.title == "preempted":
+        return "preempted"
+    if outcome.timed_out:
+        return "timeout"
+    if not outcome.crashed:
+        return "ok"
+    if outcome.title == "no output from test machine":
+        return "no_output"
+    if outcome.title == "lost connection to test machine":
+        return "lost_connection"
+    return "crash"
+
+
 def monitor_execution(handle: RunHandle, timeout: float,
-                      ignores=None, need_executing: bool = True) -> Outcome:
-    """Consume the run's output until crash/timeout/EOF (ref vm.go:90)."""
+                      ignores=None, need_executing: bool = True,
+                      outcomes=None) -> Outcome:
+    """Consume the run's output until crash/timeout/EOF (ref vm.go:90).
+
+    `outcomes`, when set, is a labeled telemetry counter family
+    (labels=("outcome",)); every return increments its class —
+    timeout / no_output / lost_connection / preempted / crash / ok —
+    so fleet health is a /metrics query instead of a log grep."""
+    out = _monitor(handle, timeout, ignores, need_executing)
+    if outcomes is not None:
+        try:
+            outcomes.labels(outcome=_classify(out)).inc()
+        except Exception:
+            pass          # telemetry must never break run monitoring
+    return out
+
+
+def _monitor(handle: RunHandle, timeout: float,
+             ignores=None, need_executing: bool = True) -> Outcome:
     buf = bytearray()
     window_start = 0
     deadline = time.time() + timeout

@@ -1,0 +1,409 @@
+"""Telemetry subsystem tests: registry semantics (labels, histogram
+bucketing, EWMA, the legacy StatsView facade), device-accumulator flush
+correctness against a host-side shadow count under concurrent
+admit_batch calls, trace-span propagation across a real TCP
+Poll/NewInput round trip, and /metrics served over real HTTP."""
+
+import json
+import math
+import threading
+import urllib.request
+
+import numpy as np
+import pytest
+
+from syzkaller_tpu import rpc, telemetry
+from syzkaller_tpu.telemetry import expo
+from syzkaller_tpu.telemetry.registry import log2_bucket
+
+
+# -- registry ---------------------------------------------------------------
+
+
+def test_counter_inc_and_drain():
+    r = telemetry.Registry()
+    c = r.counter("syz_test_total", "help text")
+    c.inc()
+    c.inc(4)
+    assert c.value == 5
+    assert c.drain() == 5            # first drain ships everything
+    c.inc(2)
+    assert c.drain() == 2            # second ships only the delta
+    assert c.value == 7              # absolute value is untouched
+
+
+def test_labeled_family_children():
+    r = telemetry.Registry()
+    f = r.counter("syz_test_total", labels=("vm",))
+    f.labels(vm="vm0").inc(3)
+    f.labels(vm="vm1").inc(1)
+    assert f.labels(vm="vm0").value == 3        # same child on re-lookup
+    assert f.labels(vm="vm1").value == 1
+    with pytest.raises(ValueError):
+        f.labels(bogus="x")
+    # re-registering the same name returns the same family
+    assert r.counter("syz_test_total", labels=("vm",)) is f
+    snap = r.snapshot()
+    assert snap["syz_test_total"] == {"vm=vm0": 3, "vm=vm1": 1}
+
+
+def test_log2_bucketing():
+    base, n = 1e-6, 24
+    assert log2_bucket(0.0, base, n) == 0
+    assert log2_bucket(base, base, n) == 0       # x <= base -> bucket 0
+    assert log2_bucket(2 * base, base, n) == 1   # boundary is inclusive
+    assert log2_bucket(2.1 * base, base, n) == 2
+    assert log2_bucket(1e9, base, n) == n - 1    # saturates at +Inf bucket
+    r = telemetry.Registry()
+    h = r.histogram("syz_test_seconds", base=base, nbuckets=n)
+    for x in (0.0, base, 3 * base, 1e9):
+        h.observe(x)
+    v = h.value
+    assert v["count"] == 4
+    assert v["buckets"][0] == 2 and v["buckets"][2] == 1
+    assert v["buckets"][n - 1] == 1
+    assert v["sum"] == pytest.approx(1e9 + 4 * base, rel=1e-6)
+    assert h.upper_bounds()[-1] == math.inf
+
+
+def test_ewma_rate_deterministic():
+    r = telemetry.EwmaRate("syz_test_rate", tau=60.0)
+    t = 1000.0
+    r.add(1, now=t)                  # first sample: no interval yet
+    assert r.rate(now=t) == 0.0
+    r.add(60, now=t + 1.0)           # 60 events over 1s
+    rate = r.rate(now=t + 1.0)
+    alpha = 1.0 - math.exp(-1.0 / 60.0)
+    assert rate == pytest.approx(alpha * 60.0)
+    # silence decays the estimate instead of freezing it
+    assert r.rate(now=t + 301.0) < rate
+    assert r.rate(now=t + 1.0) == pytest.approx(rate)
+
+
+def test_stats_view_facade():
+    r = telemetry.Registry()
+    alias = r.counter("syz_admission_new_inputs_total")
+    view = telemetry.StatsView(r, aliases={"manager new inputs": alias})
+    view.bump("manager new inputs", 2)
+    assert alias.value == 2
+    assert view["manager new inputs"] == 2
+    # unknown keys land in the labeled fallback family
+    view.bump("exec total", 10)
+    assert view["exec total"] == 10
+    assert view.get("never seen") is None
+    # legacy read-modify-write absolute assignment becomes a delta
+    view["exec total"] = 15
+    assert view["exec total"] == 15
+    with pytest.raises(ValueError):
+        view["exec total"] = 3       # counters are monotonic
+    assert set(dict(view)) == {"manager new inputs", "exec total"}
+    assert r.snapshot()["syz_stat_total"]["name=exec total"] == 15
+
+
+# -- device accumulators ----------------------------------------------------
+
+
+def _small_engine(ds, corpus_cap=512):
+    from syzkaller_tpu.cover.engine import CoverageEngine
+    return CoverageEngine(npcs=1 << 12, ncalls=16, corpus_cap=corpus_cap,
+                          batch=8, max_pcs_per_exec=32, telemetry=ds)
+
+
+def test_device_flush_vs_shadow_concurrent_admits():
+    """N threads fire admit_batch concurrently; the device stat vector's
+    totals must equal a host-side shadow count of what each call saw."""
+    ds = telemetry.DeviceStats()
+    eng = _small_engine(ds)
+    nthreads, per = 8, 6
+    rows_each = 4
+    shadow_admitted = np.zeros(nthreads, np.int64)
+
+    def worker(t):
+        for i in range(per):
+            base = (t * per + i) * rows_each
+            cids = np.arange(rows_each, dtype=np.int32) % 16
+            idx = ((base + np.arange(rows_each))[:, None] * 7
+                   + np.arange(32)[None, :]) % (1 << 12)
+            valid = np.ones((rows_each, 32), bool)
+            has_new, _rows = eng.admit_if_new(cids, idx.astype(np.int32),
+                                              valid)
+            shadow_admitted[t] += int(np.asarray(has_new).sum())
+
+    ts = [threading.Thread(target=worker, args=(t,))
+          for t in range(nthreads)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join()
+
+    snap = ds.snapshot()
+    ncalls = nthreads * per
+    assert snap["syz_admission_dispatches_total"] == ncalls
+    assert snap["syz_admission_gate_inputs_total"] == ncalls * rows_each
+    assert snap["syz_admission_gate_admitted_total"] == \
+        int(shadow_admitted.sum())
+
+    # flush(reset=True) folds the device vector into host cumulatives
+    # without losing anything: totals are identical before and after
+    before = eng.telemetry_flush()
+    after_reset = eng.telemetry_flush(reset=True)
+    assert np.array_equal(before, after_reset)
+    assert np.array_equal(ds.values(), after_reset)
+    assert int(np.asarray(ds.vec).sum()) == 0       # device slots zeroed
+    # post-reset dispatches keep counting from the cumulative base
+    eng.update_batch(np.zeros(2, np.int32),
+                     np.zeros((2, 32), np.int32),
+                     np.ones((2, 32), bool))
+    snap2 = ds.snapshot()
+    assert snap2["syz_admission_dispatches_total"] == ncalls
+    assert snap2["syz_cover_dispatches_total"]["kind=dense"] == 1
+
+
+def test_device_pending_increments_ride_dispatches():
+    """Host-side inc()/observe() are staged and show up in totals (and
+    get folded into the vector by the next dispatch)."""
+    ds = telemetry.DeviceStats()
+    eng = _small_engine(ds)
+    ds.inc("sparse_fallback", 3)
+    ds.observe("admission_latency", 0.001)
+    snap = ds.snapshot()                 # values() includes pending
+    assert snap["syz_cover_sparse_fallback_total"] == 3
+    hist = snap["syz_admission_latency_seconds"]
+    assert hist["count"] == 1
+    assert hist["sum"] == pytest.approx(0.001)
+    eng.update_batch(np.zeros(1, np.int32),
+                     np.zeros((1, 32), np.int32),
+                     np.ones((1, 32), bool))       # folds pending
+    assert np.asarray(ds._pending).sum() == 0
+    assert ds.snapshot()["syz_cover_sparse_fallback_total"] == 3
+
+
+def test_sparse_fallback_counted():
+    """A sparse-configured engine whose batch overflows the block budget
+    must run dense AND count the fallback."""
+    from syzkaller_tpu.cover.engine import CoverageEngine
+
+    ds = telemetry.DeviceStats()
+    eng = CoverageEngine(npcs=1 << 14, ncalls=8, corpus_cap=32, batch=8,
+                         max_pcs_per_exec=64, max_touched_blocks=2,
+                         telemetry=ds)
+    rng = np.random.default_rng(3)
+    idx = rng.integers(0, 1 << 14, size=(4, 64)).astype(np.int32)
+    eng.update_batch_sparse(np.zeros(4, np.int32), idx,
+                            np.ones((4, 64), bool))
+    snap = ds.snapshot()
+    assert snap["syz_cover_sparse_fallback_total"] == 1
+    assert snap["syz_cover_dispatches_total"]["kind=dense"] == 1
+    assert snap["syz_cover_dispatches_total"]["kind=sparse"] == 0
+
+
+def test_observe_batch_matches_scalar_bucketing():
+    from syzkaller_tpu.telemetry.device import HIST_BASE
+
+    a, b = telemetry.DeviceStats(), telemetry.DeviceStats()
+    xs = [0.0, HIST_BASE, 2 * HIST_BASE, 2.1 * HIST_BASE, 0.5, 1e9]
+    for x in xs:
+        a.observe("exec_latency", x)
+    b.observe_batch("exec_latency", xs)
+    assert np.array_equal(a.values(), b.values())
+
+
+# -- trace spans ------------------------------------------------------------
+
+
+def test_span_wire_roundtrip():
+    ctx = telemetry.SpanContext(origin="vm0")
+    with ctx.span("work"):
+        pass
+    ctx.add_hop("more", 0.25)
+    back = telemetry.SpanContext.from_wire(ctx.to_wire())
+    assert back.trace_id == ctx.trace_id
+    assert [h.name for h in back.hops] == ["work", "more"]
+    assert back.hops[1].dur == pytest.approx(0.25, abs=1e-6)
+    assert telemetry.SpanContext.from_wire(None) is None
+    assert telemetry.SpanContext.from_wire({"no": "id"}) is None
+
+
+def test_tracer_ring_wraps():
+    tr = telemetry.Tracer(capacity=4)
+    for i in range(10):
+        ctx = tr.new_trace(origin=f"t{i}")
+        tr.record(ctx, final_hop="done", dur=0.001)
+    assert tr.recorded_total == 10
+    snap = tr.snapshot(n=8)
+    assert len(snap) == 4                       # ring capacity
+    assert snap[-1]["origin"] == "t9"           # newest last
+    assert all(t["total_us"] >= 1000 for t in snap)
+
+
+@pytest.fixture
+def live_manager(tmp_path):
+    from syzkaller_tpu.manager.config import Config
+    from syzkaller_tpu.manager.manager import Manager
+
+    cfg = Config(name="telem", workdir=str(tmp_path / "m"), type="local",
+                 count=1, descriptions="probe.txt", npcs=1 << 12,
+                 corpus_cap=64, http="")
+    mgr = Manager(cfg)
+    mgr.server.serve_background()
+    yield mgr
+    mgr.stop()
+
+
+def test_trace_propagates_over_tcp(live_manager):
+    """A span injected client-side rides the JSON wire into the manager:
+    Poll traces are recorded by the RPC observer, NewInput traces by the
+    admission path with coalescer + device-dispatch hops."""
+    mgr = live_manager
+    cli = rpc.RpcClient(f"127.0.0.1:{mgr.rpc_port}")
+    try:
+        cli.call("Manager.Connect", {"name": "vmT"})
+        poll_span = telemetry.SpanContext(origin="vmT")
+        cli.call("Manager.Poll", {"name": "vmT",
+                                  "stats": {"exec total": 7}},
+                 span=poll_span)
+        # client-side hop appended after the round trip
+        assert poll_span.hops[-1].name == "rpc:Manager.Poll"
+        meta = mgr.table.calls[0]
+        ni_span = telemetry.SpanContext(origin="vmT")
+        ni_span.add_hop("fuzzer:triage+minimize", 0.012)
+        cli.call("Manager.NewInput", {
+            "name": "vmT", "prog": rpc.b64(b"p()\n"), "call": meta.name,
+            "call_index": 0, "cover": [0x10, 0x20, 0x30]}, span=ni_span)
+    finally:
+        cli.close()
+    assert len(mgr.corpus) == 1
+    traces = mgr.tracer.snapshot()
+    by_id = {t["trace_id"]: t for t in traces}
+    assert poll_span.trace_id in by_id
+    ni = by_id[ni_span.trace_id]
+    hops = [h["name"] for h in ni["hops"]]
+    # the end-to-end chain: fuzzer-side hop -> wire -> admission hops
+    assert hops[0] == "fuzzer:triage+minimize"
+    assert "rpc transit (approx)" in hops
+    assert "manager:admit" in hops
+    assert any("device dispatch" in h for h in hops)
+    assert ni["total_us"] > 0
+    assert all(h["dur_us"] >= 0 for h in ni["hops"])
+    # Poll shipped exec stats into the typed exec plane
+    assert mgr.stats.get("exec total") == 7
+    assert mgr._f_vm_execs.labels(vm="vmT").value == 7
+
+
+# -- exposition -------------------------------------------------------------
+
+
+def test_metrics_endpoint_over_http(live_manager):
+    """GET /metrics on the real HTTP server: valid Prometheus text with
+    >= 20 series covering admission/coverage/exec/crash/RPC planes, and
+    /telemetry JSON carrying an end-to-end trace with per-hop durations."""
+    from syzkaller_tpu.manager import html
+
+    mgr = live_manager
+    # drive real traffic so the series carry values
+    cli = rpc.RpcClient(f"127.0.0.1:{mgr.rpc_port}")
+    try:
+        cli.call("Manager.Connect", {"name": "vmH"})
+        cli.call("Manager.Poll", {"name": "vmH",
+                                  "stats": {"exec total": 3}},
+                 span=telemetry.SpanContext(origin="vmH"))
+        meta = mgr.table.calls[0]
+        span = telemetry.SpanContext(origin="vmH")
+        cli.call("Manager.NewInput", {
+            "name": "vmH", "prog": rpc.b64(b"q()\n"), "call": meta.name,
+            "call_index": 0, "cover": [0x40, 0x50]}, span=span)
+    finally:
+        cli.close()
+    srv = html.serve(mgr, "127.0.0.1", 0)
+    try:
+        host, port = srv.server_address
+        with urllib.request.urlopen(
+                f"http://{host}:{port}/metrics", timeout=10) as resp:
+            assert resp.status == 200
+            assert resp.headers["Content-Type"].startswith("text/plain")
+            text = resp.read().decode()
+        series = expo.parse_prometheus_text(text)
+        assert len(series) >= 20
+        for must in ("syz_admission_inputs_total",
+                     "syz_admission_new_inputs_total",
+                     'syz_cover_dispatches_total{kind="dense"}',
+                     "syz_exec_rate",
+                     "syz_crash_total",
+                     'syz_rpc_requests_total{method="Manager.Poll"}',
+                     "syz_corpus_size",
+                     "syz_uptime_seconds"):
+            assert must in series, f"missing series {must}"
+        assert series["syz_admission_inputs_total"] == 1
+        assert series["syz_admission_new_inputs_total"] == 1
+        assert series['syz_rpc_requests_total{method="Manager.Poll"}'] == 1
+        assert series["syz_corpus_size"] == 1
+        # histogram rendering: cumulative buckets end at +Inf == count
+        inf_key = 'syz_rpc_request_seconds_bucket{le="+Inf"}'
+        assert series[inf_key] == series["syz_rpc_request_seconds_count"]
+        with urllib.request.urlopen(
+                f"http://{host}:{port}/telemetry", timeout=10) as resp:
+            snap = json.loads(resp.read().decode())
+        assert snap["metrics"]["syz_admission_new_inputs_total"] == 1
+        traces = snap["traces"]
+        assert any(t["trace_id"] == span.trace_id and
+                   len(t["hops"]) >= 2 for t in traces)
+    finally:
+        srv.shutdown()
+
+
+def test_hub_metrics_endpoint(tmp_path):
+    from syzkaller_tpu.hub.hub import Hub
+    from syzkaller_tpu.hub import http as hub_http
+
+    hub = Hub(str(tmp_path / "hub"), key="k")
+    hub.serve_background()
+    srv = None
+    try:
+        cli = rpc.RpcClient("%s:%d" % hub.addr)
+        try:
+            cli.call("Hub.Connect", {"name": "mgrX", "key": "k",
+                                     "fresh": True})
+            cli.call("Hub.Sync", {"name": "mgrX", "key": "k",
+                                  "add": [rpc.b64(b"prog-a")]})
+        finally:
+            cli.close()
+        srv = hub_http.serve(hub, "127.0.0.1", 0)
+        host, port = srv.server_address
+        with urllib.request.urlopen(
+                f"http://{host}:{port}/metrics", timeout=10) as resp:
+            series = expo.parse_prometheus_text(resp.read().decode())
+        assert series["syz_hub_progs_added_total"] == 1
+        assert series["syz_hub_corpus_size"] == 1
+        assert series['syz_hub_rpc_requests_total{method="Hub.Sync"}'] == 1
+    finally:
+        if srv is not None:
+            srv.shutdown()
+        hub.close()
+
+
+def test_persist_snapshot(tmp_path):
+    r = telemetry.Registry()
+    r.counter("syz_x_total").inc(5)
+    snap = expo.snapshot([r])
+    for _ in range(2):
+        latest = expo.persist_snapshot(str(tmp_path), snap)
+    with open(latest) as f:
+        got = json.loads(f.read())
+    assert got["metrics"]["syz_x_total"] == 5
+    with open(str(tmp_path / "telemetry.jsonl")) as f:
+        assert len(f.read().splitlines()) == 2
+
+
+def test_vm_outcome_classification():
+    from syzkaller_tpu.vm.monitor import Outcome, _classify
+
+    assert _classify(Outcome("timed out", None, b"", False,
+                             timed_out=True)) == "timeout"
+    assert _classify(Outcome("preempted", None, b"", False,
+                             timed_out=True)) == "preempted"
+    assert _classify(Outcome("no output from test machine", None, b"",
+                             True)) == "no_output"
+    assert _classify(Outcome("lost connection to test machine", None,
+                             b"", True)) == "lost_connection"
+    assert _classify(Outcome("KASAN: use-after-free", None, b"",
+                             True)) == "crash"
