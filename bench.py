@@ -572,6 +572,19 @@ def main(argv=None):
     _stage("corpus scale")
     extras.update(bench_corpus_scale(np.random.default_rng(13),
                                      C=2048 if args.smoke else 100_000))
+    # static-analysis gate trajectory: the BENCH_*.json series records
+    # the vet finding counts alongside throughput, so a PR that buys
+    # speed by parking P0s in the baseline shows up in the history
+    _stage("vet")
+    from syzkaller_tpu.vet import core as vet_core
+
+    vrep = vet_core.run_repo()
+    vc = vrep.counts()
+    extras["vet_findings_total"] = vc["total"]
+    extras["vet_findings"] = {
+        "p0_unbaselined": vc["p0_unbaselined"], "p0": vc["p0"],
+        "p1": vc["p1"], "baselined": vc["baselined"],
+        "by_pass": vc["by_pass"]}
     _stage("done")
 
     print(json.dumps({

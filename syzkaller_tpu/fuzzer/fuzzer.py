@@ -441,19 +441,29 @@ class Fuzzer:
     def _pick_corpus_row(self, ncorpus: int, rand: P.Rand) -> int:
         """Corpus pick for mutation: device-drawn signal-weighted rows
         (consumed from a cached batch, one jit call per ~256 picks) with
-        a uniform host fallback."""
+        a uniform host fallback.  The refill draw is a device round
+        trip, so it runs OUTSIDE self._mu — holding the proc-shared
+        mutex across it would stall every other proc thread for the
+        tunnel latency (syz-vet lock pass); a concurrent double-refill
+        just buffers extra draws."""
         if self.signal is not None:
             with self._mu:
-                if not self._corpus_rows:
-                    try:
-                        rows = self.signal.sample_corpus_indices(256)
-                        self._corpus_rows.extend(int(x) for x in rows)
-                    except Exception:
-                        pass
                 if self._corpus_rows:
                     row = self._corpus_rows.popleft()
                     if row < ncorpus:
                         return row
+                    return rand.intn(ncorpus)
+            try:
+                rows = self.signal.sample_corpus_indices(256)
+            except Exception:
+                rows = []
+            if len(rows):
+                with self._mu:
+                    self._corpus_rows.extend(int(x) for x in rows)
+                    if self._corpus_rows:
+                        row = self._corpus_rows.popleft()
+                        if row < ncorpus:
+                            return row
         return rand.intn(ncorpus)
 
     def generate_seeded(self, rand: P.Rand, choice: "int | None") -> M.Prog:

@@ -71,9 +71,14 @@ class Hub:
 
     def rpc_connect(self, params: dict) -> dict:
         name = self._auth(params)
+        # the lock covers the in-memory mutation only; staged disk
+        # writes flush after release so concurrent managers' syncs
+        # don't serialize on file I/O (syz-vet lock pass)
         with self._mu:
             self.state.connect(name, bool(params.get("fresh")),
                                params.get("calls"))
+            writes = self.state.take_writes()
+        self.state.flush_writes(writes)
         log.logf(0, "hub: manager %s connected (fresh=%s)",
                  name, bool(params.get("fresh")))
         return {}
@@ -84,6 +89,8 @@ class Hub:
         with self._mu:
             fresh = self.state.add(name, add)
             progs, more = self.state.pending(name)
+            writes = self.state.take_writes()
+        self.state.flush_writes(writes)
         self._c_added.inc(fresh)
         self._c_shipped.inc(len(progs))
         log.logf(1, "hub: sync %s: +%d fresh, -> %d progs (%d more)",

@@ -41,6 +41,7 @@ class HubState:
         self.seq: list[tuple[str, bytes]] = []
         self.sigs: set[str] = set()
         self.managers: dict[str, ManagerState] = {}
+        self._writes: list[tuple[str, bytes]] = []   # staged disk writes
         self._load()
 
     def _load(self) -> None:
@@ -75,9 +76,34 @@ class HubState:
             log.logf(0, "hub: loaded %d corpus entries, %d managers",
                      len(self.seq), len(self.managers))
 
-    def _save_manager(self, m: ManagerState) -> None:
-        with open(os.path.join(self.mgr_dir, m.name), "w") as f:
-            json.dump(m.to_json(), f)
+    # Mutators stage disk writes instead of performing them: the hub's
+    # RPC handlers hold the hub lock around the in-memory mutation, and
+    # a handler holding a lock across file I/O serializes every
+    # manager's sync on the disk (syz-vet lock pass, P0
+    # blocking-under-lock).  Call `take_writes()` under the lock and
+    # `flush_writes()` after releasing it.
+
+    def _stage_manager(self, m: ManagerState) -> None:
+        self._writes.append((os.path.join(self.mgr_dir, m.name),
+                             json.dumps(m.to_json()).encode()))
+
+    def take_writes(self) -> list[tuple[str, bytes]]:
+        """Drain staged (path, content) disk writes (call locked)."""
+        out, self._writes = self._writes, []
+        return out
+
+    @staticmethod
+    def flush_writes(writes: list[tuple[str, bytes]]) -> None:
+        """Apply staged writes (call unlocked).  Each write is atomic
+        (tmp + rename); concurrent flushes may reorder two snapshots of
+        the same manager meta, which at worst rewinds a cursor — the
+        manager re-pulls a few programs it already dedups by sig."""
+        for path, content in writes:
+            tmp = os.path.join(os.path.dirname(path),
+                               f".tmp.{os.path.basename(path)}")
+            with open(tmp, "wb") as f:
+                f.write(content)
+            os.replace(tmp, path)
 
     def connect(self, name: str, fresh: bool,
                 calls: "list[str] | None") -> None:
@@ -86,7 +112,7 @@ class HubState:
             m = ManagerState(name=name)
         m.calls = set(calls) if calls is not None else None
         self.managers[name] = m
-        self._save_manager(m)
+        self._stage_manager(m)
 
     def add(self, name: str, progs: list[bytes]) -> int:
         """Programs pushed by a manager; returns how many were fresh."""
@@ -100,11 +126,10 @@ class HubState:
             self.seq.append((sig, data))
             m.added += 1
             fresh += 1
-            with open(os.path.join(self.corpus_dir,
-                                   f"{len(self.seq) - 1:08d}-{sig}"),
-                      "wb") as f:
-                f.write(data)
-        self._save_manager(m)
+            self._writes.append((
+                os.path.join(self.corpus_dir,
+                             f"{len(self.seq) - 1:08d}-{sig}"), data))
+        self._stage_manager(m)
         return fresh
 
     def pending(self, name: str, max_progs: int = 100
@@ -124,5 +149,5 @@ class HubState:
                     continue
             out.append(data)
         more = len(self.seq) - m.cursor
-        self._save_manager(m)
+        self._stage_manager(m)
         return out, more

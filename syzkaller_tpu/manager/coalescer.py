@@ -32,6 +32,7 @@ from dataclasses import dataclass, field
 import numpy as np
 
 from syzkaller_tpu.utils import log
+from syzkaller_tpu.utils.shapes import pow2_bucket
 
 
 @dataclass
@@ -188,17 +189,11 @@ class AdmissionCoalescer:
             # batch's kernel cost — per-step work should follow the
             # batch's live size instead
             n = len(fresh)
-            kb = self.MIN_K
             maxlen = max(min(len(p.cover), self.K) for p in fresh)
-            while kb < maxlen:
-                kb *= 2
-            kb = min(kb, self.K)
+            kb = pow2_bucket(maxlen, self.MIN_K, self.K)
             idx, valid = mgr.pcmap.map_batch([p.cover for p in fresh],
                                              K=kb)
-            B = self.MIN_B
-            while B < n:
-                B *= 2
-            B = min(B, self.max_batch)
+            B = pow2_bucket(n, self.MIN_B, self.max_batch)
             call_ids = np.zeros((B,), np.int32)
             pidx = np.zeros((B, kb), np.int32)
             pval = np.zeros((B, kb), bool)

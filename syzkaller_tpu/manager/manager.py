@@ -310,8 +310,13 @@ class Manager:
         short = CHOICES_PER_POLL - len(choices)
         if short > 0:
             t0 = time.monotonic()
-            choices += [int(x) for x in self.engine.sample_next_calls(
-                np.full((short,), -1, np.int32))]
+            # fixed-shape top-up draw: `short` varies with the ring's
+            # fill level, and every distinct batch size would compile a
+            # fresh sampling kernel (syz-vet retrace pass) — draw the
+            # full batch and slice
+            draws = self.engine.sample_next_calls(
+                np.full((CHOICES_PER_POLL,), -1, np.int32))
+            choices += [int(x) for x in draws[:short]]
             if self.device_stats is not None:
                 self.device_stats.observe("choice_draw_latency",
                                           time.monotonic() - t0)

@@ -1,7 +1,9 @@
 """One-command CI gate (ref Makefile:61-69 `make presubmit` =
-generate + build + test): compile the description table, build the
-native executor, run the full pytest suite on the 8-virtual-device CPU
-mesh, and smoke the device engine.
+generate + build + vet + test): compile the description table, run the
+syz-vet static analyzer (lock discipline, device hot-path purity,
+retrace hazards, RPC schema drift, stats lint), build the native
+executor, run the full pytest suite on the 8-virtual-device CPU mesh,
+and smoke the device engine.
 
     python -m syzkaller_tpu.presubmit [--quick]
 """
@@ -68,31 +70,17 @@ def main(argv=None) -> int:
         if r.returncode != 0:
             raise SystemExit("engine smoke failed")
 
-    def stats_lint():
-        # the stat plane is typed (telemetry/registry.py): new direct
-        # `self.stats[...]` mutations must go through the registry or
-        # the StatsView facade — reject them everywhere but telemetry/
-        import re
-
-        pat = re.compile(r"self\.stats\[")
-        bad: list[str] = []
-        pkg = os.path.join(root, "syzkaller_tpu")
-        targets = [os.path.join(root, "bench.py")]
-        for dirpath, _dirs, files in os.walk(pkg):
-            if os.path.basename(dirpath) == "telemetry":
-                continue
-            targets += [os.path.join(dirpath, f) for f in files
-                        if f.endswith(".py") and f != "presubmit.py"]
-        for path in targets:
-            with open(path, encoding="utf-8") as f:
-                for ln, line in enumerate(f, 1):
-                    if pat.search(line):
-                        bad.append(f"{os.path.relpath(path, root)}:{ln}")
-        if bad:
-            raise SystemExit(
-                "raw self.stats[...] access outside telemetry/ — use "
-                "typed registry metrics (telemetry/registry.py) or "
-                "StatsView.bump():\n  " + "\n  ".join(bad))
+    def vet():
+        # single static-analysis entry point (syzkaller_tpu/vet): lock
+        # discipline, device hot-path purity, retrace hazards, RPC
+        # schema drift, and the stats lint (relocated from the inline
+        # regex here — now AST-based, same contract: raw self.stats[...]
+        # outside telemetry/ blocks the gate)
+        r = subprocess.run(
+            [sys.executable, "-m", "syzkaller_tpu.vet"],
+            cwd=root, env=env)
+        if r.returncode != 0:
+            raise SystemExit(f"vet failed ({r.returncode})")
 
     # a live manager must serve /metrics with the core series on every
     # plane — the contract dashboards and bench scrape against.  Runs in
@@ -152,7 +140,7 @@ print("telemetry ok: %d series" % len(series))
 
     total = 0.0
     total += step("description tables", gen_tables)
-    total += step("stats lint", stats_lint)
+    total += step("vet (static analysis + stats lint)", vet)
     total += step("native executor build", build_executor)
     total += step("engine + multichip smoke", engine_smoke)
     total += step("telemetry smoke", telemetry_smoke)

@@ -122,12 +122,22 @@ class RpcClient:
         self._id = 0
         self._mu = threading.Lock()
 
-    def _connect(self):
+    def _connect_unlocked(self) -> None:
+        """Establish the TCP connection OUTSIDE `_mu`: connect can block
+        for the full timeout, and holding the call mutex across it would
+        stall every other caller on this client for the duration
+        (syz-vet lock pass, P0 blocking-under-lock).  The fresh socket
+        is installed under the lock only if no concurrent caller won the
+        race; the loser's socket is discarded."""
         s = socket.create_connection(self.addr, timeout=self.timeout)
         s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
         s.setsockopt(socket.SOL_SOCKET, socket.SO_KEEPALIVE, 1)
-        self._sock = s
-        self._file = s.makefile("rwb")
+        with self._mu:
+            if self._sock is None:
+                self._sock = s
+                self._file = s.makefile("rwb")
+                return
+        s.close()
 
     def call(self, method: str, params: "dict | None" = None,
              span=None) -> dict:
@@ -147,10 +157,12 @@ class RpcClient:
                 span.add_hop(f"rpc:{method}", time.monotonic() - t0)
 
     def _call_locked(self, method: str, params: "dict | None") -> dict:
-        with self._mu:
-            for attempt in (0, 1):
+        for attempt in (0, 1):
+            if self._sock is None:
+                self._connect_unlocked()
+            with self._mu:
                 if self._sock is None:
-                    self._connect()
+                    continue        # raced with a close(); reconnect
                 try:
                     self._id += 1
                     req = {"id": self._id, "method": method,
@@ -168,7 +180,7 @@ class RpcClient:
                     self.close_socket()
                     if attempt == 1:
                         raise
-            raise RpcError("unreachable")
+        raise RpcError("unreachable")
 
     def close_socket(self) -> None:
         if self._sock is not None:

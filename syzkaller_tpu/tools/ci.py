@@ -16,6 +16,7 @@ from __future__ import annotations
 
 import argparse
 import hashlib
+import json
 import os
 import subprocess
 import sys
@@ -86,6 +87,20 @@ class CiDaemon:
         return fp
 
     def run_gate(self) -> bool:
+        # static analysis first, in --json mode: cheap fast-fail, and
+        # the finding counts land in the deploy log either way
+        r = subprocess.run(
+            [sys.executable, "-m", "syzkaller_tpu.vet", "--json"],
+            cwd=self.root, capture_output=True, text=True)
+        try:
+            counts = json.loads(r.stdout)["counts"]
+            log.logf(0, "ci: vet: %d finding(s) (%d P0, %d P1), "
+                     "%d unbaselined P0", counts["total"], counts["p0"],
+                     counts["p1"], counts["p0_unbaselined"])
+        except (ValueError, KeyError):
+            log.logf(0, "ci: vet report unparseable (rc=%d)", r.returncode)
+        if r.returncode != 0:
+            return False
         r = subprocess.run(
             [sys.executable, "-m", "syzkaller_tpu.presubmit", "--quick"],
             cwd=self.root)

@@ -18,39 +18,50 @@ from syzkaller_tpu.utils import log
 _mu = threading.Lock()
 
 
-def capture(out_dir: str, seconds: float = 3.0) -> str:
-    """Trace all JAX activity for `seconds`; returns the trace dir
-    (tensorboard-loadable).  Serialized: one capture at a time."""
+def _capture_locked(run_dir: str, seconds: float) -> bool:
+    """One trace window, if no other capture is running.  The JAX
+    profiler supports a single trace at a time, so captures serialize —
+    but by SKIPPING, not by queueing: sleeping the window out while
+    holding the lock would stack every concurrent /profile request into
+    a blocked thread (syz-vet lock pass, P0 blocking-under-lock)."""
     import jax
 
-    run_dir = os.path.join(out_dir, time.strftime("trace-%Y%m%d-%H%M%S"))
-    os.makedirs(run_dir, exist_ok=True)
-    with _mu:
-        log.logf(0, "profiler: capturing %gs into %s", seconds, run_dir)
+    if not _mu.acquire(blocking=False):
+        log.logf(0, "profiler: a capture is already running; skipped")
+        return False
+    try:
         jax.profiler.start_trace(run_dir)
         try:
             time.sleep(seconds)
         finally:
             jax.profiler.stop_trace()
+    finally:
+        _mu.release()
+    return True
+
+
+def capture(out_dir: str, seconds: float = 3.0) -> str:
+    """Trace all JAX activity for `seconds`; returns the trace dir
+    (tensorboard-loadable).  Raises RuntimeError when another capture
+    is already in flight."""
+    run_dir = os.path.join(out_dir, time.strftime("trace-%Y%m%d-%H%M%S"))
+    os.makedirs(run_dir, exist_ok=True)
+    log.logf(0, "profiler: capturing %gs into %s", seconds, run_dir)
+    if not _capture_locked(run_dir, seconds):
+        raise RuntimeError("a profiler capture is already running")
     return run_dir
 
 
 def capture_async(out_dir: str, seconds: float = 3.0) -> str:
     """Fire-and-forget capture (for HTTP handlers); returns the dir the
-    trace will land in."""
+    trace will land in.  A capture already in flight makes this a no-op
+    (logged), matching the one-trace-at-a-time profiler."""
     run_dir = os.path.join(out_dir, time.strftime("trace-%Y%m%d-%H%M%S"))
 
     def work():
-        import jax
-
         os.makedirs(run_dir, exist_ok=True)
-        with _mu:
-            jax.profiler.start_trace(run_dir)
-            try:
-                time.sleep(seconds)
-            finally:
-                jax.profiler.stop_trace()
-        log.logf(0, "profiler: trace written to %s", run_dir)
+        if _capture_locked(run_dir, seconds):
+            log.logf(0, "profiler: trace written to %s", run_dir)
 
     threading.Thread(target=work, daemon=True).start()
     return run_dir

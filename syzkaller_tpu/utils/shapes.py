@@ -1,0 +1,21 @@
+"""Shape bucketing for jitted dispatch call sites.
+
+Every distinct argument shape at a jit call site compiles a fresh XLA
+executable, so data-dependent sizes must be padded to a small closed
+set of shapes before dispatch.  `pow2_bucket` is the canonical helper:
+round up to a power of two within [lo, hi], keeping the compiled-shape
+set O(log(hi/lo)) while small batches avoid full-size kernel cost.
+The static analyzer (syzkaller_tpu/vet, retrace pass) recognizes it as
+a shape cleanser — route raw `len(...)` sizes through here.
+"""
+
+from __future__ import annotations
+
+
+def pow2_bucket(n: int, lo: int, hi: int) -> int:
+    """Smallest power-of-two multiple of nothing-fancy ≥ n, clamped to
+    [lo, hi].  lo must be a power of two for the result to stay one."""
+    b = max(1, lo)
+    while b < min(n, hi):
+        b *= 2
+    return min(b, hi)

@@ -1,0 +1,28 @@
+"""syz-vet: AST-based static analysis for the TPU fuzzing stack.
+
+The reference gates every change with `make presubmit` (gofmt + go vet
++ tests) and leans on the race detector; this package is the Python/JAX
+equivalent, purpose-built for this codebase's failure classes:
+
+  lock     — blocking work / device syncs under a lock, lock-order
+             cycles (five threaded planes share ~20 locks)
+  purity   — host syncs and Python branching reachable from the jitted
+             device dispatches
+  retrace  — jit call sites that bypass the pow2 shape bucketing, or
+             pass unhashables where static_argnums is declared
+  schema   — param/response key drift across the manager↔fuzzer↔hub
+             RPC boundary
+  stats    — raw `self.stats[...]` access outside telemetry/, and
+             presubmit smoke metrics missing from the registry
+
+    python -m syzkaller_tpu.vet [--json] [--baseline vet-baseline.txt]
+
+Exit status 1 only on unbaselined P0 findings.  `vet/runtime.py` ships
+the CompileCounter test companion.
+"""
+
+from syzkaller_tpu.vet.core import (     # noqa: F401
+    P0, P1, Finding, Report, SourceFile, apply_baseline, collect_files,
+    from_source, load_baseline, repo_root, run_passes, run_repo,
+)
+from syzkaller_tpu.vet.runtime import CompileCounter    # noqa: F401

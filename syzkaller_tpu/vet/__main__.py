@@ -1,0 +1,66 @@
+"""CLI: python -m syzkaller_tpu.vet [paths...]
+
+Runs every pass over the package (default) or the given files/dirs,
+applies the baseline, prints findings, and exits 1 on any unbaselined
+P0 — the presubmit gate's single static-analysis entry point.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+from syzkaller_tpu.vet import core
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m syzkaller_tpu.vet",
+        description="syz-vet static analyzer (lock discipline, device "
+                    "hot-path purity, retrace hazards, RPC schema "
+                    "drift, stats lint)")
+    ap.add_argument("paths", nargs="*",
+                    help="files/dirs to analyze (default: the "
+                         "syzkaller_tpu package + bench.py)")
+    ap.add_argument("--json", action="store_true",
+                    help="machine-readable report on stdout")
+    ap.add_argument("--baseline", default=None,
+                    help="suppression file (default: <repo>/vet-"
+                         "baseline.txt)")
+    ap.add_argument("--write-baseline", default=None, metavar="PATH",
+                    help="append idents of current unbaselined P0s to "
+                         "PATH (justifications still required by hand)")
+    ap.add_argument("--passes", default=None,
+                    help="comma-separated subset: lock,purity,retrace,"
+                         "schema,stats")
+    ap.add_argument("-v", "--verbose", action="store_true",
+                    help="also print P1 findings in text mode")
+    args = ap.parse_args(argv)
+
+    root = core.repo_root()
+    files = core.collect_files(args.paths or None, root=root)
+    passes = args.passes.split(",") if args.passes else None
+    rep = core.run_passes(files, passes=passes)
+    bpath = args.baseline or os.path.join(root, "vet-baseline.txt")
+    try:
+        rep.stale_baseline = core.apply_baseline(
+            rep.findings, core.load_baseline(bpath))
+    except ValueError as e:
+        print(f"vet: bad baseline: {e}", file=sys.stderr)
+        return 1
+
+    if args.write_baseline:
+        with open(args.write_baseline, "a", encoding="utf-8") as f:
+            for fd in rep.p0_unbaselined:
+                f.write(f"{fd.ident}  # TODO: justify\n")
+
+    if args.json:
+        print(core.main_json(rep))
+    else:
+        print(rep.render(verbose=args.verbose))
+    return 1 if (rep.p0_unbaselined or rep.parse_errors) else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,304 @@
+"""syz-vet core: findings, source loading, baselines, reports.
+
+The analyzer is the Python/JAX analog of the reference's `make
+presubmit` gofmt+vet gate (Makefile:61-69) plus the race detector's
+lock hygiene: every pass is a pure function over parsed sources
+(`list[SourceFile] -> list[Finding]`), so passes run identically over
+the real tree and over in-memory test fixtures.
+
+Findings carry a severity (P0 blocks the gate, P1 warns), a file:line
+anchor, and a stable `ident` that deliberately EXCLUDES the line
+number — baselines must survive unrelated edits above the finding.
+A baseline file suppresses specific idents with a written-down
+justification; `python -m syzkaller_tpu.vet` exits nonzero only on
+unbaselined P0s.
+"""
+
+from __future__ import annotations
+
+import ast
+import json
+import os
+from dataclasses import dataclass, field
+
+P0 = "P0"      # gate-blocking: fix it or baseline it with a reason
+P1 = "P1"      # warn: surfaced and counted, never blocks
+
+
+@dataclass
+class Finding:
+    pass_name: str        # lock, purity, retrace, schema, stats
+    rule: str             # short machine id, e.g. "blocking-under-lock"
+    severity: str         # P0 | P1
+    path: str             # repo-relative when possible
+    line: int
+    scope: str            # enclosing function/class qualname ("" = module)
+    message: str
+    hint: str = ""        # one-line fix suggestion
+    detail: str = ""      # disambiguator within a scope (e.g. lock name)
+    baselined: bool = False
+
+    @property
+    def ident(self) -> str:
+        """Stable suppression key: no line numbers, so a baseline entry
+        survives edits elsewhere in the file."""
+        return ":".join((self.pass_name, self.path, self.scope, self.rule,
+                         self.detail))
+
+    def render(self) -> str:
+        sup = " [baselined]" if self.baselined else ""
+        hint = f"\n      hint: {self.hint}" if self.hint else ""
+        return (f"{self.severity}{sup} {self.path}:{self.line} "
+                f"[{self.pass_name}/{self.rule}] {self.message}{hint}")
+
+    def to_json(self) -> dict:
+        return {"pass": self.pass_name, "rule": self.rule,
+                "severity": self.severity, "path": self.path,
+                "line": self.line, "scope": self.scope,
+                "message": self.message, "hint": self.hint,
+                "ident": self.ident, "baselined": self.baselined}
+
+
+@dataclass
+class SourceFile:
+    """One parsed source.  `path` is the repo-relative display path —
+    fixtures use virtual names like `<fixture>`."""
+    path: str
+    text: str
+    tree: "ast.AST | None" = None
+    error: "str | None" = None
+
+    def __post_init__(self):
+        if self.tree is None and self.error is None:
+            try:
+                self.tree = ast.parse(self.text, filename=self.path)
+            except SyntaxError as e:
+                self.error = f"{type(e).__name__}: {e}"
+
+
+def from_source(text: str, path: str = "<fixture>") -> SourceFile:
+    return SourceFile(path=path, text=text)
+
+
+def repo_root() -> str:
+    """The directory holding the `syzkaller_tpu` package."""
+    return os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+
+
+def collect_files(paths: "list[str] | None" = None,
+                  root: "str | None" = None) -> list[SourceFile]:
+    """Load the analysis set.  Default: the whole `syzkaller_tpu`
+    package plus the repo-root bench.py (the old stats-lint targets),
+    skipping caches and this subsystem's own fixture-bearing tests."""
+    root = root or repo_root()
+    if not paths:
+        paths = [os.path.join(root, "syzkaller_tpu")]
+        bench = os.path.join(root, "bench.py")
+        if os.path.exists(bench):
+            paths.append(bench)
+    out: list[SourceFile] = []
+    for p in paths:
+        if os.path.isfile(p):
+            out.append(_load(p, root))
+            continue
+        for dirpath, dirs, files in os.walk(p):
+            dirs[:] = [d for d in dirs
+                       if d != "__pycache__" and not d.startswith(".")]
+            for fn in sorted(files):
+                if fn.endswith(".py"):
+                    out.append(_load(os.path.join(dirpath, fn), root))
+    return out
+
+
+def _load(path: str, root: str) -> SourceFile:
+    with open(path, encoding="utf-8") as f:
+        text = f.read()
+    rel = os.path.relpath(path, root)
+    return SourceFile(path=rel, text=text)
+
+
+# -- baseline ---------------------------------------------------------------
+
+
+def load_baseline(path: str) -> dict[str, str]:
+    """ident -> justification.  One entry per line:
+
+        <ident>  # why this finding is acceptable
+
+    Blank lines and full-line comments are ignored.  Entries without a
+    justification comment are treated as unjustified and rejected —
+    the baseline documents decisions, it is not a mute button."""
+    entries: dict[str, str] = {}
+    if not os.path.exists(path):
+        return entries
+    with open(path, encoding="utf-8") as f:
+        for ln, raw in enumerate(f, 1):
+            line = raw.strip()
+            if not line or line.startswith("#"):
+                continue
+            ident, sep, why = line.partition("#")
+            ident = ident.strip()
+            why = why.strip()
+            if not sep or not why:
+                raise ValueError(
+                    f"{path}:{ln}: baseline entry has no justification "
+                    "comment (append '  # reason')")
+            entries[ident] = why
+    return entries
+
+
+def apply_baseline(findings: list[Finding],
+                   baseline: dict[str, str]) -> list[str]:
+    """Mark baselined findings; returns baseline idents that matched
+    nothing (stale entries worth pruning)."""
+    seen: set[str] = set()
+    for f in findings:
+        if f.ident in baseline:
+            f.baselined = True
+            seen.add(f.ident)
+    return [i for i in baseline if i not in seen]
+
+
+# -- report -----------------------------------------------------------------
+
+
+@dataclass
+class Report:
+    findings: list[Finding] = field(default_factory=list)
+    parse_errors: list[str] = field(default_factory=list)
+    stale_baseline: list[str] = field(default_factory=list)
+
+    @property
+    def p0_unbaselined(self) -> list[Finding]:
+        return [f for f in self.findings
+                if f.severity == P0 and not f.baselined]
+
+    def counts(self) -> dict:
+        out = {"total": len(self.findings),
+               "p0": sum(f.severity == P0 for f in self.findings),
+               "p1": sum(f.severity == P1 for f in self.findings),
+               "p0_unbaselined": len(self.p0_unbaselined),
+               "baselined": sum(f.baselined for f in self.findings)}
+        by_pass: dict[str, int] = {}
+        for f in self.findings:
+            by_pass[f.pass_name] = by_pass.get(f.pass_name, 0) + 1
+        out["by_pass"] = by_pass
+        return out
+
+    def to_json(self) -> dict:
+        return {"counts": self.counts(),
+                "findings": [f.to_json() for f in self.findings],
+                "parse_errors": self.parse_errors,
+                "stale_baseline": self.stale_baseline,
+                "ok": not self.p0_unbaselined and not self.parse_errors}
+
+    def render(self, verbose: bool = False) -> str:
+        lines: list[str] = []
+        order = {P0: 0, P1: 1}
+        for f in sorted(self.findings,
+                        key=lambda f: (order[f.severity], f.path, f.line)):
+            if f.severity == P1 and not verbose:
+                continue
+            lines.append(f.render())
+        for e in self.parse_errors:
+            lines.append(f"P0 parse error: {e}")
+        for i in self.stale_baseline:
+            lines.append(f"note: stale baseline entry (matched nothing): {i}")
+        c = self.counts()
+        lines.append(
+            f"vet: {c['total']} finding(s) "
+            f"({c['p0']} P0, {c['p1']} P1, {c['baselined']} baselined); "
+            f"{c['p0_unbaselined']} unbaselined P0")
+        return "\n".join(lines)
+
+
+# -- shared AST helpers -----------------------------------------------------
+
+
+def qualname_map(tree: ast.AST) -> "dict[ast.AST, str]":
+    """node -> dotted scope name for every function/class def."""
+    out: dict[ast.AST, str] = {}
+
+    def walk(node, prefix):
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                  ast.ClassDef)):
+                q = f"{prefix}.{child.name}" if prefix else child.name
+                out[child] = q
+                walk(child, q)
+            else:
+                walk(child, prefix)
+
+    walk(tree, "")
+    return out
+
+
+def enclosing_scope(tree: ast.AST, target: ast.AST) -> str:
+    """Dotted name of the innermost def/class containing `target`."""
+    qmap = qualname_map(tree)
+    best = ""
+    best_span = None
+    tl = getattr(target, "lineno", None)
+    if tl is None:
+        return ""
+    for node, q in qmap.items():
+        lo, hi = node.lineno, getattr(node, "end_lineno", node.lineno)
+        if lo <= tl <= hi:
+            span = hi - lo
+            if best_span is None or span < best_span:
+                best, best_span = q, span
+    return best
+
+
+def dotted(node: ast.AST) -> str:
+    """Best-effort dotted rendering of a Name/Attribute chain; '' when
+    the expression is not a plain chain."""
+    if isinstance(node, ast.Name):
+        return node.id
+    if isinstance(node, ast.Attribute):
+        base = dotted(node.value)
+        return f"{base}.{node.attr}" if base else ""
+    if isinstance(node, ast.Call):
+        return dotted(node.func)
+    return ""
+
+
+def run_passes(files: list[SourceFile], passes=None) -> Report:
+    """Run the given passes (default: all five) over parsed sources."""
+    from syzkaller_tpu.vet import locks, purity, retrace, schema, statslint
+
+    allp = {"lock": locks.check, "purity": purity.check,
+            "retrace": retrace.check, "schema": schema.check,
+            "stats": statslint.check}
+    rep = Report()
+    for sf in files:
+        if sf.error is not None:
+            rep.parse_errors.append(f"{sf.path}: {sf.error}")
+    good = [sf for sf in files if sf.tree is not None]
+    seen: set[tuple] = set()
+    for name, fn in allp.items():
+        if passes is not None and name not in passes:
+            continue
+        for f in fn(good):
+            key = (f.ident, f.line)
+            if key not in seen:         # collapse repeat hits of one site
+                seen.add(key)
+                rep.findings.append(f)
+    return rep
+
+
+def run_repo(root: "str | None" = None, baseline: "str | None" = None,
+             passes=None) -> Report:
+    """The `python -m syzkaller_tpu.vet` entry: default file set +
+    default baseline (vet-baseline.txt at the repo root)."""
+    root = root or repo_root()
+    files = collect_files(root=root)
+    rep = run_passes(files, passes=passes)
+    bpath = baseline or os.path.join(root, "vet-baseline.txt")
+    rep.stale_baseline = apply_baseline(rep.findings, load_baseline(bpath))
+    return rep
+
+
+def main_json(rep: Report) -> str:
+    return json.dumps(rep.to_json(), indent=None, sort_keys=True)

@@ -1,0 +1,411 @@
+"""Pass 1 — lock discipline.
+
+Reconstructs every `with self._mu:` region (plus whole-method regions
+created by lock-wrapper decorators like cover/engine.py's `_locked`),
+follows calls made under the lock (self-methods, typed `self.attr.m()`
+helpers — attribute types inferred from `self.attr = ClassName(...)`
+assignments — and same-module functions, up to two hops), and flags:
+
+  * P0 `blocking-under-lock`: host-blocking work held under a lock —
+    `time.sleep`, `subprocess.*`, socket connect/send/recv, `open()` /
+    `json.dump` file I/O, `urlopen`, RPC client `.call(...)`, and
+    `.wait()` on anything that is NOT the held condition variable
+    (Condition.wait releases the lock it is called on; Event.wait does
+    not release anything).
+  * P1 `device-sync-under-lock`: a host↔device round trip under a lock
+    (`.block_until_ready()`, `jax.device_get`, `np.asarray`/`np.array`
+    of a device-valued expression, or one of the engine's readback
+    APIs).  Sometimes by design (the engine's own serialization lock
+    covers donated buffers) — hence warn, not block.
+  * P0 `lock-order-cycle`: a cycle in the acquired-while-holding graph.
+
+Lock identity is `Class.attr` for `self.attr` locks, `module:name` for
+module-level locks; a lock attribute defined by exactly one class is
+unified across receivers (so `mgr._admit_mu` in the coalescer and the
+manager's own `self._admit_mu` are the same node).
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+
+from syzkaller_tpu.vet.core import P0, P1, Finding, SourceFile, dotted
+
+LOCK_CTORS = {"Lock", "RLock", "Condition", "Semaphore", "BoundedSemaphore"}
+
+# host-blocking call patterns: exact dotted names, dotted prefixes, and
+# method (attribute) names
+BLOCKING_DOTTED = {"time.sleep", "json.dump", "socket.create_connection"}
+BLOCKING_PREFIX = ("subprocess.",)
+BLOCKING_ATTRS = {"sendall", "recv", "accept", "create_connection",
+                  "urlopen"}
+BLOCKING_BUILTINS = {"open"}
+
+# engine/device APIs whose call implies a host↔device round trip
+DEVICE_SYNC_METHODS = {
+    "block_until_ready", "device_get",
+    "sample_corpus_rows", "sample_next_calls", "sample_corpus_indices",
+    "random_words", "cover_counts", "max_cover_counts", "covered_indices",
+    "cover_pcs", "max_cover_pcs", "telemetry_flush",
+}
+# np.asarray/np.array arguments that smell like device values
+DEVICE_VALUE_HINT = re.compile(
+    r"_fn\(|\bhas_new\b|\bnew_bits\b|\.vec\b|device_get|engine\.")
+
+
+def _is_lock_ctor(call: ast.AST) -> bool:
+    if not isinstance(call, ast.Call):
+        return False
+    d = dotted(call.func)
+    return d.split(".")[-1] in LOCK_CTORS and (
+        "threading" in d or "." not in d)
+
+
+class _Module:
+    """Per-file index: classes, methods, lock definitions, attr types,
+    decorator-lock wrappers."""
+
+    def __init__(self, sf: SourceFile):
+        self.sf = sf
+        self.classes: dict[str, ast.ClassDef] = {}
+        self.methods: dict[str, dict[str, ast.FunctionDef]] = {}
+        self.functions: dict[str, ast.FunctionDef] = {}
+        self.class_locks: dict[str, set[str]] = {}     # class -> attrs
+        self.module_locks: set[str] = set()
+        self.attr_types: dict[tuple[str, str], str] = {}  # (cls,attr)->Cls
+        self.deco_locks: dict[str, str] = {}           # decorator -> attr
+        self._index()
+
+    def _index(self) -> None:
+        tree = self.sf.tree
+        for node in tree.body:
+            if isinstance(node, ast.ClassDef):
+                self.classes[node.name] = node
+                meths = {}
+                for item in node.body:
+                    if isinstance(item, ast.FunctionDef):
+                        meths[item.name] = item
+                self.methods[node.name] = meths
+                for sub in ast.walk(node):
+                    if not isinstance(sub, ast.Assign):
+                        continue
+                    for tgt in sub.targets:
+                        if (isinstance(tgt, ast.Attribute)
+                                and isinstance(tgt.value, ast.Name)
+                                and tgt.value.id == "self"):
+                            if _is_lock_ctor(sub.value):
+                                self.class_locks.setdefault(
+                                    node.name, set()).add(tgt.attr)
+                            elif isinstance(sub.value, ast.Call):
+                                cn = dotted(sub.value.func).split(".")[-1]
+                                if cn and cn[0].isupper():
+                                    self.attr_types[(node.name, tgt.attr)] \
+                                        = cn
+            elif isinstance(node, ast.FunctionDef):
+                self.functions[node.name] = node
+                self._maybe_deco_lock(node)
+            elif isinstance(node, ast.Assign):
+                for tgt in node.targets:
+                    if isinstance(tgt, ast.Name) and _is_lock_ctor(node.value):
+                        self.module_locks.add(tgt.id)
+
+    def _maybe_deco_lock(self, fn: ast.FunctionDef) -> None:
+        """Detect `def _locked(fn): def wrapper(self,...): with self.X: ...`
+        so decorated methods count as whole-body lock regions."""
+        for item in fn.body:
+            if not isinstance(item, ast.FunctionDef):
+                continue
+            for sub in ast.walk(item):
+                if isinstance(sub, ast.With):
+                    for w in sub.items:
+                        d = dotted(w.context_expr)
+                        if d.startswith("self."):
+                            self.deco_locks[fn.name] = d.split(".", 1)[1]
+                            return
+
+
+class _Index:
+    """Cross-file lookup: class name -> (_Module, ClassDef) when the
+    name is defined exactly once, and lock-attr ownership."""
+
+    def __init__(self, mods: list[_Module]):
+        self.mods = mods
+        self.class_owner: dict[str, _Module] = {}
+        dup: set[str] = set()
+        for m in mods:
+            for cname in m.classes:
+                if cname in self.class_owner:
+                    dup.add(cname)
+                else:
+                    self.class_owner[cname] = m
+        for d in dup:
+            self.class_owner.pop(d, None)
+        # lock attr -> owning classes (for receiver unification)
+        self.lock_attr_classes: dict[str, set[str]] = {}
+        for m in mods:
+            for cname, attrs in m.class_locks.items():
+                for a in attrs:
+                    self.lock_attr_classes.setdefault(a, set()).add(cname)
+
+    def unified_lock(self, attr: str, recv_text: str) -> str:
+        owners = self.lock_attr_classes.get(attr, set())
+        if len(owners) == 1:
+            return f"{next(iter(owners))}.{attr}"
+        return f"{recv_text}.{attr}"
+
+
+def _lock_id(mod: _Module, idx: _Index, expr: ast.AST,
+             cls: "str | None") -> "str | None":
+    """Normalized lock node id for a with-context expression, or None
+    when the expression is not a known lock."""
+    d = dotted(expr)
+    if not d:
+        return None
+    if d in mod.module_locks:
+        return f"{mod.sf.path}:{d}"
+    if "." not in d:
+        return None
+    recv, attr = d.rsplit(".", 1)
+    if recv == "self" and cls is not None:
+        if attr in mod.class_locks.get(cls, set()):
+            return f"{cls}.{attr}"
+    if attr in idx.lock_attr_classes:
+        if recv == "self" and cls is not None:
+            # self.attr matching another class's lock attr: unify only
+            # when unique, else scope to this class
+            uni = idx.unified_lock(attr, recv)
+            return uni if "." in uni and not uni.startswith("self") \
+                else f"{cls}.{attr}"
+        return idx.unified_lock(attr, recv)
+    return None
+
+
+def _resolve_callee(mod: _Module, idx: _Index, cls: "str | None",
+                    call: ast.Call):
+    """(owner_module, func_def, owner_class) for a followable call, or
+    None.  Handles self.m(), self.attr.m() via inferred attr types, and
+    bare same-module f()."""
+    f = call.func
+    if isinstance(f, ast.Name):
+        fn = mod.functions.get(f.id)
+        if fn is not None:
+            return mod, fn, None
+        return None
+    if not isinstance(f, ast.Attribute):
+        return None
+    recv = f.value
+    if isinstance(recv, ast.Name) and recv.id == "self" and cls:
+        m = mod.methods.get(cls, {}).get(f.attr)
+        if m is not None:
+            return mod, m, cls
+        return None
+    # self.attr.m() / name.attr ... : try inferred attribute types
+    rd = dotted(recv)
+    if rd.startswith("self.") and cls:
+        tname = mod.attr_types.get((cls, rd.split(".", 1)[1]))
+        if tname:
+            owner = idx.class_owner.get(tname)
+            if owner is not None:
+                m = owner.methods.get(tname, {}).get(f.attr)
+                if m is not None:
+                    return owner, m, tname
+    return None
+
+
+def _scan_stmts(body):
+    """Yield every expression-bearing node in a statement list, skipping
+    nested function/class definitions (their bodies do not run under
+    the lock)."""
+    stack = list(body)
+    while stack:
+        node = stack.pop()
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda, ast.ClassDef)):
+            continue
+        yield node
+        for child in ast.iter_child_nodes(node):
+            stack.append(child)
+
+
+def _classify_call(call: ast.Call, held_lock_expr: str
+                   ) -> "tuple[str, str] | None":
+    """(severity, description) when this call is blocking/syncing."""
+    d = dotted(call.func)
+    leaf = d.split(".")[-1] if d else ""
+    if d in BLOCKING_DOTTED or any(d.startswith(p) for p in BLOCKING_PREFIX):
+        return P0, d
+    if leaf in BLOCKING_ATTRS:
+        return P0, d or leaf
+    if isinstance(call.func, ast.Name) and call.func.id in BLOCKING_BUILTINS:
+        return P0, call.func.id + "()"
+    if leaf == "wait" and "." in d:
+        recv = d.rsplit(".", 1)[0]
+        if recv != held_lock_expr:
+            return P0, d + " (does not release the held lock)"
+    if leaf == "call" and "." in d:
+        recv_leaf = d.rsplit(".", 1)[0].split(".")[-1]
+        if "client" in recv_leaf:
+            return P0, d + " (RPC round trip)"
+    if leaf in DEVICE_SYNC_METHODS:
+        return P1, d or leaf
+    if leaf in ("asarray", "array") and d.startswith(("np.", "numpy.")):
+        args = call.args[:1]
+        if args:
+            try:
+                txt = ast.unparse(args[0])
+            except Exception:
+                txt = ""
+            if DEVICE_VALUE_HINT.search(txt):
+                return P1, f"{d}({txt})"
+    return None
+
+
+def check(files: list[SourceFile]) -> list[Finding]:
+    mods = [_Module(sf) for sf in files]
+    idx = _Index(mods)
+    findings: list[Finding] = []
+    # acquired-while-holding graph: lock -> {lock: (path, line)}
+    edges: dict[str, dict[str, tuple[str, int]]] = {}
+
+    for mod in mods:
+        for region in _regions(mod, idx):
+            _scan_region(mod, idx, region, findings, edges)
+
+    findings.extend(_cycles(edges))
+    return findings
+
+
+def _fn_owners(tree: ast.AST):
+    """Yield (fn, owner_class_name_or_None, scope) for every function
+    definition, attributing nested defs to their enclosing class."""
+
+    def walk(node, cls, prefix):
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, ast.ClassDef):
+                yield from walk(child, child.name, child.name)
+            elif isinstance(child, ast.FunctionDef):
+                scope = f"{prefix}.{child.name}" if prefix else child.name
+                yield child, cls, scope
+                yield from walk(child, cls, scope)
+            else:
+                yield from walk(child, cls, prefix)
+
+    yield from walk(tree, None, "")
+
+
+def _regions(mod: _Module, idx: _Index):
+    """Yield (lock_id, lock_expr_text, body, scope, cls, line)."""
+    for fn, owner, scope in _fn_owners(mod.sf.tree):
+        # with-regions directly in this function (nested defs get their
+        # own iteration, so exclude their subtrees here)
+        for node in _scan_stmts(fn.body):
+            if not isinstance(node, ast.With):
+                continue
+            for item in node.items:
+                lid = _lock_id(mod, idx, item.context_expr, owner)
+                if lid is None:
+                    continue
+                yield (lid, dotted(item.context_expr), node.body,
+                       scope, owner, node.lineno)
+        # decorator-lock whole-body regions
+        for deco in fn.decorator_list:
+            lattr = mod.deco_locks.get(dotted(deco).split(".")[-1])
+            if lattr and owner:
+                yield (f"{owner}.{lattr}", f"self.{lattr}", fn.body,
+                       scope, owner, fn.lineno)
+
+
+def _scan_region(mod, idx, region, findings, edges) -> None:
+    lid, lexpr, body, scope, cls, line = region
+
+    def flag(sev, desc, at_line, via=""):
+        msg = (f"{desc} under lock {lid}"
+               + (f" (via {via})" if via else ""))
+        hint = ("move the blocking work outside the lock; hold the lock "
+                "only around the shared-state mutation"
+                if sev == P0 else
+                "device round trips under a lock serialize every "
+                "contender; fetch outside or document why it is safe")
+        findings.append(Finding(
+            pass_name="lock", rule=("blocking-under-lock" if sev == P0
+                                    else "device-sync-under-lock"),
+            severity=sev, path=mod.sf.path, line=at_line, scope=scope,
+            message=msg, hint=hint,
+            detail=f"{lid}:{desc.split('(')[0].strip()}"
+                   + (f":via={via}" if via else "")))
+
+    def scan(stmts, via, depth, owner_mod, owner_cls, anchor):
+        for node in _scan_stmts(stmts):
+            if isinstance(node, ast.With) and depth == 0:
+                for item in node.items:
+                    inner = _lock_id(owner_mod, idx, item.context_expr,
+                                     owner_cls)
+                    if inner is not None and inner != lid:
+                        edges.setdefault(lid, {}).setdefault(
+                            inner, (mod.sf.path, node.lineno))
+            if not isinstance(node, ast.Call):
+                continue
+            at = node.lineno if depth == 0 else anchor
+            hit = _classify_call(node, lexpr if depth == 0 else "")
+            if hit is not None:
+                flag(hit[0], hit[1], at, via)
+                continue
+            if depth >= 2:
+                continue
+            resolved = _resolve_callee(owner_mod, idx, owner_cls, node)
+            if resolved is None:
+                continue
+            cmod, cfn, ccls = resolved
+            # a callee that itself takes the same lock (decorated or
+            # with-block) is a region of its own; still record edges
+            for sub in _scan_stmts(cfn.body):
+                if isinstance(sub, ast.With):
+                    for item in sub.items:
+                        inner = _lock_id(cmod, idx, item.context_expr, ccls)
+                        if inner is not None and inner != lid:
+                            edges.setdefault(lid, {}).setdefault(
+                                inner, (mod.sf.path, node.lineno))
+            for deco in cfn.decorator_list:
+                lattr = cmod.deco_locks.get(dotted(deco).split(".")[-1])
+                if lattr and ccls:
+                    inner = f"{ccls}.{lattr}"
+                    if inner != lid:
+                        edges.setdefault(lid, {}).setdefault(
+                            inner, (mod.sf.path, node.lineno))
+            callee_name = (f"{ccls}.{cfn.name}" if ccls else cfn.name)
+            scan(cfn.body, callee_name, depth + 1, cmod, ccls, at)
+
+    scan(body, "", 0, mod, cls, line)
+
+
+def _cycles(edges) -> list[Finding]:
+    findings: list[Finding] = []
+    seen_cycles: set[frozenset] = set()
+
+    def dfs(node, stack, on_stack):
+        for nxt, (path, line) in edges.get(node, {}).items():
+            if nxt in on_stack:
+                cyc = stack[stack.index(nxt):] + [nxt]
+                key = frozenset(cyc)
+                if key not in seen_cycles:
+                    seen_cycles.add(key)
+                    findings.append(Finding(
+                        pass_name="lock", rule="lock-order-cycle",
+                        severity=P0, path=path, line=line,
+                        scope="", message="lock-order cycle: "
+                        + " -> ".join(cyc),
+                        hint="impose a global acquisition order (or drop "
+                             "one nesting) to make deadlock impossible",
+                        detail="|".join(sorted(key))))
+                continue
+            if nxt not in visited:
+                visited.add(nxt)
+                dfs(nxt, stack + [nxt], on_stack | {nxt})
+
+    visited: set[str] = set()
+    for start in list(edges):
+        if start not in visited:
+            visited.add(start)
+            dfs(start, [start], {start})
+    return findings

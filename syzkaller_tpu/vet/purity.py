@@ -1,0 +1,320 @@
+"""Pass 2 — device hot-path purity.
+
+Everything reachable from a `jax.jit` dispatch must stay traceable:
+no host synchronization (`float()`/`int()`/`bool()` on a traced value,
+`.item()`/`.tolist()`, `np.*` applied to tracers) and no Python
+branching on traced values (`if`/`while`/`assert` on a tracer raises a
+TracerBoolConversionError at best, silently bakes in one trace-time
+branch at worst).
+
+Mechanism: a light forward taint analysis.  Roots are jit-decorated
+functions (`@jax.jit`, `@functools.partial(jax.jit, ...)`), functions
+wrapped at assignment (`f = jax.jit(g, ...)`), and inline `jax.jit(g)`
+call sites.  Root params are tainted except `static_argnums` /
+`static_argnames`.  Taint propagates through assignments, except
+through shape-space escapes (`.shape`/`.dtype`/`.ndim`/`.size`,
+`len()`), and follows calls to same-module functions with call-site
+argument binding (the jit closures in cover/engine.py call the
+module-level kernels this way).  Function arguments handed to
+`jax.lax.{scan,fori_loop,while_loop,cond,map}` are analyzed with every
+param tainted — their bodies run traced by construction.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from syzkaller_tpu.vet.core import P0, Finding, SourceFile, dotted
+
+SHAPE_ATTRS = {"shape", "dtype", "ndim", "size"}
+CONCRETIZERS = {"float", "int", "bool", "complex", "len"}
+HOST_METHODS = {"item", "tolist", "__array__", "block_until_ready"}
+LAX_CONTROL = {"scan", "fori_loop", "while_loop", "cond", "map",
+               "associative_scan"}
+MAX_DEPTH = 4
+
+
+def _expr_names(e: ast.AST, stop_shape: bool = True):
+    """Yield Name ids referenced by expression `e`, skipping subtrees
+    that land in shape space (static under jit)."""
+    stack = [e]
+    while stack:
+        node = stack.pop()
+        if stop_shape and isinstance(node, ast.Attribute) \
+                and node.attr in SHAPE_ATTRS:
+            continue
+        if stop_shape and isinstance(node, ast.Call) \
+                and isinstance(node.func, ast.Name) \
+                and node.func.id == "len":
+            continue
+        if isinstance(node, ast.Name):
+            yield node.id
+        for child in ast.iter_child_nodes(node):
+            stack.append(child)
+
+
+def _tainted(e: ast.AST, taint: set) -> bool:
+    return any(n in taint for n in _expr_names(e))
+
+
+def _target_names(t: ast.AST):
+    if isinstance(t, ast.Name):
+        yield t.id
+    elif isinstance(t, (ast.Tuple, ast.List)):
+        for el in t.elts:
+            yield from _target_names(el)
+    elif isinstance(t, ast.Starred):
+        yield from _target_names(t.value)
+
+
+def _static_params(fn: ast.FunctionDef, jit_kwargs: dict) -> set:
+    """Param names made static by static_argnums/static_argnames."""
+    params = [a.arg for a in fn.args.args]
+    out: set = set()
+    nums = jit_kwargs.get("static_argnums")
+    if isinstance(nums, (list, tuple)):
+        for i in nums:
+            if isinstance(i, int) and 0 <= i < len(params):
+                out.add(params[i])
+    elif isinstance(nums, int) and 0 <= nums < len(params):
+        out.add(params[nums])
+    names = jit_kwargs.get("static_argnames")
+    if isinstance(names, str):
+        out.add(names)
+    elif isinstance(names, (list, tuple)):
+        out.update(n for n in names if isinstance(n, str))
+    return out
+
+
+def _literal(node: ast.AST):
+    try:
+        return ast.literal_eval(node)
+    except (ValueError, TypeError, SyntaxError):
+        return None
+
+
+def _jit_kwargs(call: ast.Call) -> dict:
+    return {kw.arg: _literal(kw.value) for kw in call.keywords if kw.arg}
+
+
+def _is_jit(node: ast.AST) -> "dict | None":
+    """Return jit kwargs when `node` denotes a jit wrapper: `jax.jit`,
+    bare `jit`, or `functools.partial(jax.jit, ...)`.  A Call node is
+    ONLY a wrapper in the partial form — `dotted()` follows through
+    Call.func, so without the guard the outer application in
+    `jax.jit(f)(x)` would double-match as its own wrapper."""
+    if isinstance(node, ast.Call):
+        if dotted(node.func).endswith("partial") and node.args \
+                and dotted(node.args[0]) in ("jax.jit", "jit"):
+            return _jit_kwargs(node)
+        return None
+    if dotted(node) in ("jax.jit", "jit"):
+        return {}
+    return None
+
+
+def _local_functions(tree: ast.AST) -> dict[str, ast.FunctionDef]:
+    """All named function defs in the module, keyed by bare name
+    (nested closures included — the engine's jit kernels live inside
+    `_build`).  Name collisions keep the first definition."""
+    out: dict[str, ast.FunctionDef] = {}
+    for node in ast.walk(tree):
+        if isinstance(node, ast.FunctionDef) and node.name not in out:
+            out[node.name] = node
+    return out
+
+
+def find_roots(sf: SourceFile) -> list[tuple[ast.FunctionDef, dict]]:
+    """(function, jit_kwargs) for every jit root in the file."""
+    roots: list[tuple[ast.FunctionDef, dict]] = []
+    funcs = _local_functions(sf.tree)
+    for node in ast.walk(sf.tree):
+        if isinstance(node, ast.FunctionDef):
+            for deco in node.decorator_list:
+                kw = _is_jit(deco)
+                if kw is not None:
+                    roots.append((node, kw))
+        elif isinstance(node, ast.Call):
+            kw = _is_jit(node.func)
+            if kw is None:
+                continue
+            kw = dict(kw)
+            kw.update(_jit_kwargs(node))
+            if node.args and isinstance(node.args[0], ast.Name):
+                fn = funcs.get(node.args[0].id)
+                if fn is not None:
+                    roots.append((fn, kw))
+    return roots
+
+
+class _Analyzer:
+    def __init__(self, sf: SourceFile, findings: list[Finding]):
+        self.sf = sf
+        self.findings = findings
+        self.funcs = _local_functions(sf.tree)
+        self.memo: set[tuple[int, frozenset]] = set()
+
+    def flag(self, rule: str, node: ast.AST, scope: str, msg: str,
+             hint: str, detail: str) -> None:
+        self.findings.append(Finding(
+            pass_name="purity", rule=rule, severity=P0, path=self.sf.path,
+            line=getattr(node, "lineno", 0), scope=scope, message=msg,
+            hint=hint, detail=detail))
+
+    def analyze(self, fn: ast.FunctionDef, tainted_params: set,
+                depth: int = 0) -> None:
+        key = (id(fn), frozenset(tainted_params))
+        if key in self.memo or depth > MAX_DEPTH:
+            return
+        self.memo.add(key)
+        taint = set(tainted_params)
+        scope = fn.name
+        local_defs = {n.name: n for n in ast.walk(fn)
+                      if isinstance(n, ast.FunctionDef) and n is not fn}
+
+        def visit(stmts):
+            for st in stmts:
+                self._stmt(st, taint, scope, local_defs, depth)
+
+        visit(fn.body)
+
+    def _stmt(self, st: ast.stmt, taint, scope, local_defs, depth):
+        if isinstance(st, (ast.FunctionDef, ast.AsyncFunctionDef,
+                           ast.ClassDef)):
+            return
+        if isinstance(st, (ast.Assign, ast.AnnAssign, ast.AugAssign)):
+            value = st.value
+            if value is not None:
+                self._expr(value, taint, scope, local_defs, depth)
+                if _tainted(value, taint):
+                    targets = (st.targets if isinstance(st, ast.Assign)
+                               else [st.target])
+                    for t in targets:
+                        taint.update(_target_names(t))
+            return
+        if isinstance(st, (ast.If, ast.While)):
+            if _tainted(st.test, taint):
+                self.flag(
+                    "traced-branch", st, scope,
+                    f"Python `{'if' if isinstance(st, ast.If) else 'while'}`"
+                    f" on a traced value ({ast.unparse(st.test)[:60]})",
+                    "use jnp.where / lax.cond / lax.while_loop — Python "
+                    "control flow concretizes the tracer",
+                    f"branch:{ast.unparse(st.test)[:40]}")
+            self._expr(st.test, taint, scope, local_defs, depth)
+            for body in (st.body, st.orelse):
+                for sub in body:
+                    self._stmt(sub, taint, scope, local_defs, depth)
+            return
+        if isinstance(st, ast.Assert):
+            if _tainted(st.test, taint):
+                self.flag(
+                    "traced-assert", st, scope,
+                    f"assert on a traced value "
+                    f"({ast.unparse(st.test)[:60]})",
+                    "use checkify or move the check to the host caller",
+                    f"assert:{ast.unparse(st.test)[:40]}")
+            return
+        if isinstance(st, ast.For):
+            if _tainted(st.iter, taint):
+                taint.update(_target_names(st.target))
+            self._expr(st.iter, taint, scope, local_defs, depth)
+            for body in (st.body, st.orelse):
+                for sub in body:
+                    self._stmt(sub, taint, scope, local_defs, depth)
+            return
+        if isinstance(st, (ast.Return, ast.Expr)):
+            if st.value is not None:
+                self._expr(st.value, taint, scope, local_defs, depth)
+            return
+        if isinstance(st, (ast.With,)):
+            for sub in st.body:
+                self._stmt(sub, taint, scope, local_defs, depth)
+            return
+        # everything else: still scan embedded expressions
+        for child in ast.iter_child_nodes(st):
+            if isinstance(child, ast.expr):
+                self._expr(child, taint, scope, local_defs, depth)
+
+    def _expr(self, e: ast.expr, taint, scope, local_defs, depth):
+        for node in ast.walk(e):
+            if isinstance(node, ast.IfExp) and _tainted(node.test, taint):
+                self.flag(
+                    "traced-branch", node, scope,
+                    "conditional expression on a traced value "
+                    f"({ast.unparse(node.test)[:60]})",
+                    "use jnp.where — `a if t else b` concretizes t",
+                    f"ifexp:{ast.unparse(node.test)[:40]}")
+            if not isinstance(node, ast.Call):
+                continue
+            self._call(node, taint, scope, local_defs, depth)
+
+    def _call(self, call: ast.Call, taint, scope, local_defs, depth):
+        d = dotted(call.func)
+        leaf = d.split(".")[-1] if d else ""
+        # float(x) / int(x) / bool(x) on a tracer
+        if isinstance(call.func, ast.Name) \
+                and call.func.id in CONCRETIZERS - {"len"} \
+                and any(_tainted(a, taint) for a in call.args):
+            self.flag(
+                "host-concretize", call, scope,
+                f"{call.func.id}() applied to a traced value",
+                "keep it an array (jnp ops) or hoist the concretization "
+                "out of the jitted path",
+                f"conc:{call.func.id}:{ast.unparse(call.args[0])[:40]}")
+            return
+        # .item() / .tolist() / .block_until_ready() on a tracer
+        if isinstance(call.func, ast.Attribute) and leaf in HOST_METHODS \
+                and _tainted(call.func.value, taint):
+            self.flag(
+                "host-sync", call, scope,
+                f".{leaf}() on a traced value",
+                "host syncs cannot run inside a jitted dispatch",
+                f"sync:{leaf}:{ast.unparse(call.func.value)[:40]}")
+            return
+        # np.* on tracers (jnp is fine)
+        if d.startswith(("np.", "numpy.")) \
+                and any(_tainted(a, taint) for a in call.args):
+            self.flag(
+                "numpy-on-tracer", call, scope,
+                f"{d}() applied to a traced value",
+                "use the jnp equivalent — numpy forces a host transfer",
+                f"np:{leaf}")
+            return
+        # lax control-flow bodies run traced with every param tainted
+        if leaf in LAX_CONTROL and ("lax" in d or d == leaf):
+            for a in call.args:
+                if isinstance(a, ast.Name):
+                    fn = local_defs.get(a.id) or self.funcs.get(a.id)
+                    if fn is not None:
+                        self.analyze(
+                            fn, {p.arg for p in fn.args.args}, depth + 1)
+            return
+        # follow same-module calls with argument binding
+        fn = None
+        if isinstance(call.func, ast.Name):
+            fn = local_defs.get(call.func.id) or self.funcs.get(call.func.id)
+        if fn is None:
+            return
+        params = [a.arg for a in fn.args.args]
+        bound: set = set()
+        for i, a in enumerate(call.args):
+            if i < len(params) and _tainted(a, taint):
+                bound.add(params[i])
+        for kw in call.keywords:
+            if kw.arg in params and _tainted(kw.value, taint):
+                bound.add(kw.arg)
+        self.analyze(fn, bound, depth + 1)
+
+
+def check(files: list[SourceFile]) -> list[Finding]:
+    findings: list[Finding] = []
+    for sf in files:
+        roots = find_roots(sf)
+        if not roots:
+            continue
+        an = _Analyzer(sf, findings)
+        for fn, kw in roots:
+            tainted = {a.arg for a in fn.args.args} - _static_params(fn, kw)
+            an.analyze(fn, tainted)
+    return findings

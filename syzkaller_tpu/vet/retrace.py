@@ -1,0 +1,222 @@
+"""Pass 3 — retrace hazards.
+
+Every distinct argument shape (and every distinct static argument
+value) at a `jax.jit` call site compiles a fresh XLA executable; a
+shape that tracks runtime data (`len(batch)`) turns the dispatch cache
+into a compile treadmill.  The repo's idiom is pow2 shape bucketing
+(`pow2_bucket`, `nwords_for`, the coalescer's MIN_B/MIN_K buckets), so
+the pass flags call sites that bypass it:
+
+  * P1 `unbucketed-shape`: an argument of a jitted dispatch (a
+    `*_fn` closure attribute, a known engine dispatch method, or a
+    jit-decorated function) references a raw data-dependent size — a
+    name assigned from `len(...)`, or a direct `len(...)` in the
+    argument — with no bucketing helper in between.
+  * P0 `unhashable-static`: a list/set/dict literal (or comprehension)
+    passed positionally where the jitted callee declares
+    `static_argnums` — TypeError at runtime, found at vet time.
+  * P1 `jit-per-call`: `jax.jit(...)` applied inside a function body
+    (especially to a lambda) and invoked inline — the wrapper identity
+    changes per call, so every invocation retraces.
+
+The runtime companion (`vet/runtime.py` CompileCounter) pins what this
+pass cannot prove: tests assert the fused dispatch paths hold their
+expected compile counts.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+
+from syzkaller_tpu.vet.core import P0, P1, Finding, SourceFile, dotted
+from syzkaller_tpu.vet.purity import _is_jit, find_roots
+
+# engine dispatch methods that hand their argument shapes straight to a
+# jitted step (their callers own the bucketing; methods that pad/bucket
+# internally — admit_rows, DeviceSignal.merge_corpus — are not sinks)
+SINKS = {
+    "update_batch", "update_batch_async", "update_batch_sparse",
+    "update_stream", "admit_if_new", "admit_batch", "pack_batch",
+    "pack_or_rows", "triage_diff", "add_flakes",
+    "sample_next_calls",
+}
+CLEANSER = re.compile(r"pow2|bucket|nwords_for|pad")
+UNHASHABLE = (ast.List, ast.Set, ast.Dict, ast.ListComp, ast.SetComp,
+              ast.DictComp, ast.GeneratorExp)
+
+
+def _scoped_calls(tree: ast.AST):
+    """Yield (call, enclosing_function_or_None, scope_name)."""
+
+    def walk(node, fn, scope):
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, ast.FunctionDef):
+                sub = f"{scope}.{child.name}" if scope else child.name
+                yield from walk(child, child, sub)
+            elif isinstance(child, ast.ClassDef):
+                yield from walk(child, fn, child.name)
+            else:
+                if isinstance(child, ast.Call):
+                    yield child, fn, scope
+                yield from walk(child, fn, scope)
+
+    yield from walk(tree, None, "")
+
+
+def _has_cleanser(e: ast.AST) -> bool:
+    for node in ast.walk(e):
+        if isinstance(node, ast.Call) and CLEANSER.search(
+                dotted(node.func).split(".")[-1] or ""):
+            return True
+    return False
+
+
+ARRAY_CTORS = {"zeros", "ones", "empty", "full"}
+
+
+def _raw_expr(e: ast.AST, raw: set) -> bool:
+    """Does `e` evaluate to a raw data-dependent size (or an array
+    shaped by one)?  Size-position only: a len() buried as an ordinary
+    call argument is data, not a shape."""
+    if _has_cleanser(e):
+        return False
+    if isinstance(e, ast.Name):
+        return e.id in raw
+    if isinstance(e, ast.Call):
+        d = dotted(e.func)
+        leaf = d.split(".")[-1]
+        if d == "len":
+            return True
+        if leaf in ("min", "max", "abs"):
+            args = list(e.args)
+            args += [g.elt for g in e.args
+                     if isinstance(g, ast.GeneratorExp)]
+            return any(_raw_expr(a, raw) for a in args)
+        if leaf in ARRAY_CTORS and e.args:
+            # np.zeros((n, K)): the array inherits the raw shape
+            shape = e.args[0]
+            elts = shape.elts if isinstance(shape, ast.Tuple) else [shape]
+            return any(_raw_expr(x, raw) for x in elts)
+        if leaf in ("asarray", "array") and e.args:
+            return _raw_expr(e.args[0], raw)
+        return False
+    if isinstance(e, ast.BinOp):
+        return _raw_expr(e.left, raw) or _raw_expr(e.right, raw)
+    if isinstance(e, ast.UnaryOp):
+        return _raw_expr(e.operand, raw)
+    if isinstance(e, ast.IfExp):
+        return _raw_expr(e.body, raw) or _raw_expr(e.orelse, raw)
+    return False
+
+
+def _raw_sizes(fn: ast.FunctionDef) -> set:
+    """Names in `fn` carrying a raw (unbucketed) data-dependent size."""
+    raw: set = set()
+    for _ in range(2):          # one propagation round
+        for node in ast.walk(fn):
+            if not isinstance(node, (ast.Assign, ast.AugAssign,
+                                     ast.AnnAssign)):
+                continue
+            value = node.value
+            if value is None or not _raw_expr(value, raw):
+                continue
+            targets = (node.targets if isinstance(node, ast.Assign)
+                       else [node.target])
+            for t in targets:
+                if isinstance(t, ast.Name):
+                    raw.add(t.id)
+    return raw
+
+
+def check(files: list[SourceFile]) -> list[Finding]:
+    findings: list[Finding] = []
+    for sf in files:
+        roots = {fn.name: kw for fn, kw in find_roots(sf)}
+        statics = {name: kw for name, kw in roots.items()
+                   if kw.get("static_argnums") is not None}
+        # self._X_fn = _localname aliases (the engine's _build idiom)
+        aliases: dict[str, str] = {}
+        for node in ast.walk(sf.tree):
+            if isinstance(node, ast.Assign) \
+                    and isinstance(node.value, ast.Name) \
+                    and node.value.id in roots:
+                for t in node.targets:
+                    if isinstance(t, ast.Attribute):
+                        aliases[t.attr] = node.value.id
+
+        for call, fn, scope in _scoped_calls(sf.tree):
+            d = dotted(call.func)
+            leaf = d.split(".")[-1] if d else ""
+            # inline jit wrapping inside a function body (the call node
+            # itself is `jax.jit(target)`; covers `jax.jit(f)(x)` too —
+            # the inner application is its own visited Call)
+            if fn is not None:
+                if _is_jit(call.func) is not None and call.args \
+                        and not isinstance(call.args[0], ast.Constant):
+                    what = ("a lambda"
+                            if isinstance(call.args[0], ast.Lambda)
+                            else ast.unparse(call.args[0])[:40])
+                    findings.append(Finding(
+                        pass_name="retrace", rule="jit-per-call",
+                        severity=P1, path=sf.path, line=call.lineno,
+                        scope=scope,
+                        message=f"jax.jit({what}) built inside a function "
+                                "body — the wrapper (and its trace cache) "
+                                "is recreated per call",
+                        hint="hoist the jitted wrapper to module/init "
+                             "scope so the compile cache persists",
+                        detail=f"jit-per-call:{what[:30]}"))
+            # unhashable values in static positions
+            target_statics = None
+            if leaf in statics:
+                target_statics = statics[leaf]
+            elif leaf in aliases and aliases[leaf] in statics:
+                target_statics = statics[aliases[leaf]]
+            if target_statics is not None:
+                nums = target_statics.get("static_argnums")
+                nums = (nums,) if isinstance(nums, int) else (nums or ())
+                for i in nums:
+                    if isinstance(i, int) and i < len(call.args) \
+                            and isinstance(call.args[i], UNHASHABLE):
+                        findings.append(Finding(
+                            pass_name="retrace", rule="unhashable-static",
+                            severity=P0, path=sf.path, line=call.lineno,
+                            scope=scope,
+                            message=f"unhashable "
+                                    f"{type(call.args[i]).__name__} passed "
+                                    f"at static_argnums position {i} of "
+                                    f"{leaf}",
+                            hint="static args must be hashable — pass a "
+                                 "tuple, or make the arg traced",
+                            detail=f"static:{leaf}:{i}"))
+            # raw-size shapes into jitted dispatches
+            if fn is None:
+                continue
+            is_sink = (leaf.endswith("_fn") or leaf in SINKS
+                       or leaf in roots)
+            if not is_sink:
+                continue
+            raw = _raw_sizes(fn)
+            # positional args only: keyword args on these dispatches are
+            # scalar metadata (corpus_index=...), not shape-carrying
+            for a in call.args:
+                if _raw_expr(a, raw):
+                    hit = sorted({n.id for n in ast.walk(a)
+                                  if isinstance(n, ast.Name)
+                                  and n.id in raw})
+                    why = (f"size name(s) {hit}" if hit
+                           else "a direct len(...)")
+                    findings.append(Finding(
+                        pass_name="retrace", rule="unbucketed-shape",
+                        severity=P1, path=sf.path, line=call.lineno,
+                        scope=scope,
+                        message=f"jitted dispatch {leaf}(...) takes {why} "
+                                "— every distinct size compiles a new "
+                                "executable",
+                        hint="bucket the size (pow2_bucket / pad to a "
+                             "fixed shape) before the dispatch",
+                        detail=f"shape:{leaf}:"
+                               f"{'|'.join(sorted(hit)) or 'len'}"))
+                    break
+    return findings

@@ -1,0 +1,71 @@
+"""Runtime companion to the static retrace pass: count real XLA
+compilations.
+
+The static analyzer can prove a call site bypasses the bucketing
+helpers, but not that a dispatch path holds its compile count — shapes
+flow through too many layers.  `CompileCounter` pins it empirically:
+jax.monitoring emits a `/jax/core/compile/backend_compile_duration`
+event per XLA backend compilation, so
+
+    with CompileCounter() as cc:
+        engine.update_batch(ids, idx, valid)   # warmed-up shapes
+    assert cc.count == 0
+
+turns a retrace regression into a test failure.  One process-global
+listener registers lazily on first use (jax.monitoring has no
+unregister; `clear_event_listeners` would nuke other subscribers), and
+contexts toggle collection.  Counting is process-wide — concurrent
+device work from other threads lands in the active window, so tests
+should quiesce background dispatch while counting.
+"""
+
+from __future__ import annotations
+
+import threading
+
+_mu = threading.Lock()
+_registered = False
+_active: "list[CompileCounter]" = []
+
+COMPILE_EVENT = "backend_compile"
+
+
+def _listener(event: str, duration: float = 0.0, **kwargs) -> None:
+    if COMPILE_EVENT not in event:
+        return
+    with _mu:
+        for cc in _active:
+            cc.count += 1
+            cc.events.append(event)
+
+
+def _ensure_listener() -> None:
+    global _registered
+    with _mu:
+        if _registered:
+            return
+        _registered = True
+    import jax.monitoring
+
+    jax.monitoring.register_event_duration_secs_listener(_listener)
+
+
+class CompileCounter:
+    """Context manager counting XLA compilations in its window."""
+
+    def __init__(self):
+        self.count = 0
+        self.events: list[str] = []
+
+    def __enter__(self) -> "CompileCounter":
+        _ensure_listener()
+        with _mu:
+            self.count = 0
+            self.events = []
+            _active.append(self)
+        return self
+
+    def __exit__(self, *exc) -> None:
+        with _mu:
+            if self in _active:
+                _active.remove(self)

@@ -1,0 +1,295 @@
+"""Pass 4 — RPC schema drift across the manager↔fuzzer↔hub boundary.
+
+The wire plane (rpc.py) is schemaless JSON: a param key written by the
+fuzzer but never read by the manager handler (or vice versa) fails
+silently — the exact class of bug a typed RPC layer would catch at
+compile time.  This pass reconstructs the de-facto schema from the AST:
+
+  * handlers: `server.register("Service.Method", self.rpc_x)` binds a
+    method name to a handler; the handler's reads are `params["k"]`
+    (required) and `params.get("k")` (optional), unioned through
+    helpers the params dict is passed to (e.g. hub `_auth(params)`).
+  * call sites: `client.call("Service.Method", {dict literal})` — the
+    literal keys are the written schema.  Non-literal params make the
+    site opaque (key checks are skipped, method existence still holds).
+  * responses: handler `return {dict literal}` keys vs caller
+    `r.get("k")` / `r["k"]` reads on the variable bound to the call.
+
+Findings:
+  * P0 `unregistered-method`: a called method with no handler.
+  * P0 `param-never-written`: handler reads `params["k"]` (hard
+    KeyError) but no literal call site writes k.
+  * P1 `param-unread` / `param-never-written` (optional reads) /
+    `response-drift`: asymmetric keys in either direction.
+
+`trace` is allowlisted in both directions: RpcClient.call injects it
+and the telemetry observer reads it for every method.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from syzkaller_tpu.vet.core import P0, P1, Finding, SourceFile, dotted
+
+ALLOW_KEYS = {"trace"}
+FOLLOW_DEPTH = 3
+
+
+class _Mod:
+    def __init__(self, sf: SourceFile):
+        self.sf = sf
+        self.functions: dict[str, ast.FunctionDef] = {}
+        self.methods: dict[str, dict[str, ast.FunctionDef]] = {}
+        for node in sf.tree.body:
+            if isinstance(node, ast.FunctionDef):
+                self.functions[node.name] = node
+            elif isinstance(node, ast.ClassDef):
+                self.methods[node.name] = {
+                    m.name: m for m in node.body
+                    if isinstance(m, ast.FunctionDef)}
+
+    def resolve(self, expr: ast.AST) -> "ast.FunctionDef | None":
+        """Handler expression -> function def (self.m or module f)."""
+        d = dotted(expr)
+        if d.startswith("self."):
+            name = d.split(".", 1)[1]
+            for meths in self.methods.values():
+                if name in meths:
+                    return meths[name]
+        return self.functions.get(d)
+
+
+def _dict_keys(node: ast.AST) -> "set[str] | None":
+    """Keys of a dict literal; None when not statically known."""
+    if not isinstance(node, ast.Dict):
+        return None
+    keys: set[str] = set()
+    for k in node.keys:
+        if isinstance(k, ast.Constant) and isinstance(k.value, str):
+            keys.add(k.value)
+        else:
+            return None
+    return keys
+
+
+def _param_reads(mod: _Mod, fn: ast.FunctionDef, pname: str,
+                 depth: int = 0) -> tuple[set, set]:
+    """(required, optional) keys `fn` reads from dict param `pname`,
+    following helpers the dict is handed to."""
+    req: set = set()
+    opt: set = set()
+    if depth > FOLLOW_DEPTH:
+        return req, opt
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Subscript) \
+                and isinstance(node.value, ast.Name) \
+                and node.value.id == pname \
+                and isinstance(node.slice, ast.Constant) \
+                and isinstance(node.slice.value, str):
+            req.add(node.slice.value)
+        elif isinstance(node, ast.Call):
+            f = node.func
+            if isinstance(f, ast.Attribute) and f.attr == "get" \
+                    and isinstance(f.value, ast.Name) \
+                    and f.value.id == pname and node.args \
+                    and isinstance(node.args[0], ast.Constant):
+                opt.add(node.args[0].value)
+                continue
+            # params handed onward: union the callee's reads
+            callee = mod.resolve(f)
+            if callee is None:
+                continue
+            cparams = [a.arg for a in callee.args.args]
+            if cparams and cparams[0] == "self":
+                cparams = cparams[1:]
+            for i, a in enumerate(node.args):
+                if isinstance(a, ast.Name) and a.id == pname \
+                        and i < len(cparams):
+                    r, o = _param_reads(mod, callee, cparams[i], depth + 1)
+                    req |= r
+                    opt |= o
+    return req, opt
+
+
+def _response_keys(mod: _Mod, fn: ast.FunctionDef) -> "set[str] | None":
+    """Union of handler return-dict keys; None when any return is
+    opaque (delegated / computed)."""
+    keys: set = set()
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Return) and node.value is not None:
+            k = _dict_keys(node.value)
+            if k is None:
+                return None
+            keys |= k
+    return keys
+
+
+def _result_reads(func: ast.FunctionDef, call: ast.Call
+                  ) -> tuple[set, set]:
+    """Keys read from the variable the `.call(...)` result is bound to
+    within the same function: (required, optional)."""
+    var = None
+    for node in ast.walk(func):
+        if isinstance(node, ast.Assign) and node.value is call:
+            for t in node.targets:
+                if isinstance(t, ast.Name):
+                    var = t.id
+    if var is None:
+        return set(), set()
+    return _param_reads(_EMPTY_MOD, func, var)
+
+
+class _EmptyMod:
+    functions: dict = {}
+    methods: dict = {}
+
+    def resolve(self, expr):
+        return None
+
+
+_EMPTY_MOD = _EmptyMod()
+
+
+def check(files: list[SourceFile]) -> list[Finding]:
+    findings: list[Finding] = []
+    handlers: dict[str, tuple] = {}     # method -> (mod, fn, line)
+    # method -> list of (mod, func, call, written_keys|None)
+    sites: dict[str, list] = {}
+
+    mods = [_Mod(sf) for sf in files]
+    for mod in mods:
+        for node in ast.walk(mod.sf.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            f = node.func
+            if not isinstance(f, ast.Attribute):
+                continue
+            if f.attr == "register" and len(node.args) >= 2 \
+                    and isinstance(node.args[0], ast.Constant) \
+                    and isinstance(node.args[0].value, str):
+                fn = mod.resolve(node.args[1])
+                if fn is not None:
+                    handlers[node.args[0].value] = (mod, fn, node.lineno)
+            elif f.attr == "call" and node.args \
+                    and isinstance(node.args[0], ast.Constant) \
+                    and isinstance(node.args[0].value, str) \
+                    and "." in node.args[0].value:
+                method = node.args[0].value
+                written = (_dict_keys(node.args[1])
+                           if len(node.args) > 1 else set())
+                func = _enclosing_function(mod.sf.tree, node)
+                sites.setdefault(method, []).append(
+                    (mod, func, node, written))
+
+    if not handlers:
+        return findings        # nothing to compare against
+
+    for method, slist in sorted(sites.items()):
+        h = handlers.get(method)
+        if h is None:
+            mod, _func, call, _w = slist[0]
+            findings.append(Finding(
+                pass_name="schema", rule="unregistered-method",
+                severity=P0, path=mod.sf.path, line=call.lineno,
+                scope=method,
+                message=f"RPC method {method} is called but no handler "
+                        "registers it",
+                hint="register the handler or fix the method name",
+                detail=f"method:{method}"))
+            continue
+        hmod, hfn, hline = h
+        pparams = [a.arg for a in hfn.args.args]
+        pname = pparams[1] if pparams[:1] == ["self"] and len(pparams) > 1 \
+            else (pparams[0] if pparams else "params")
+        req, opt = _param_reads(hmod, hfn, pname)
+        read = req | opt
+        written_union: set = set()
+        any_opaque = False
+        for _mod, _func, _call, written in slist:
+            if written is None:
+                any_opaque = True
+            else:
+                written_union |= written
+        has_literal = any(w is not None for *_x, w in slist)
+        # handler reads vs written keys
+        if has_literal and not any_opaque:
+            for k in sorted(req - written_union - ALLOW_KEYS):
+                findings.append(Finding(
+                    pass_name="schema", rule="param-never-written",
+                    severity=P0, path=hmod.sf.path, line=hline,
+                    scope=method,
+                    message=f"handler requires params[{k!r}] but no "
+                            f"{method} call site writes it (KeyError on "
+                            "the wire)",
+                    hint="write the key at the call sites or use "
+                         ".get() with a default",
+                    detail=f"param:{method}:{k}:required"))
+            for k in sorted(opt - written_union - ALLOW_KEYS):
+                findings.append(Finding(
+                    pass_name="schema", rule="param-never-written",
+                    severity=P1, path=hmod.sf.path, line=hline,
+                    scope=method,
+                    message=f"handler reads params.get({k!r}) but no "
+                            f"{method} call site writes it",
+                    hint="dead read or missing writer — reconcile the "
+                         "schema",
+                    detail=f"param:{method}:{k}:optional"))
+        # written keys the handler never reads
+        for k in sorted(written_union - read - ALLOW_KEYS):
+            mod0, _f0, call0, _w0 = slist[0]
+            findings.append(Finding(
+                pass_name="schema", rule="param-unread",
+                severity=P1, path=mod0.sf.path, line=call0.lineno,
+                scope=method,
+                message=f"{method} call sites write param {k!r} but the "
+                        "handler never reads it",
+                hint="drop the key or read it handler-side",
+                detail=f"param:{method}:{k}:unread"))
+        # response schema
+        resp = _response_keys(hmod, hfn)
+        read_req: set = set()
+        read_opt: set = set()
+        for _mod, func, call, _w in slist:
+            if func is None:
+                continue
+            r, o = _result_reads(func, call)
+            read_req |= r
+            read_opt |= o
+        if resp is not None:
+            for k in sorted((read_req | read_opt) - resp - ALLOW_KEYS):
+                sev = P0 if k in read_req else P1
+                mod0, _f0, call0, _w0 = slist[0]
+                findings.append(Finding(
+                    pass_name="schema", rule="response-drift",
+                    severity=sev, path=mod0.sf.path, line=call0.lineno,
+                    scope=method,
+                    message=f"caller reads {k!r} from the {method} "
+                            "response but the handler never returns it",
+                    hint="return the key or drop the read",
+                    detail=f"resp:{method}:{k}"))
+            if read_req | read_opt:
+                for k in sorted(resp - read_req - read_opt - ALLOW_KEYS):
+                    findings.append(Finding(
+                        pass_name="schema", rule="response-drift",
+                        severity=P1, path=hmod.sf.path, line=hline,
+                        scope=method,
+                        message=f"handler returns {k!r} in the {method} "
+                                "response but no caller reads it",
+                        hint="dead response field — drop it or use it",
+                        detail=f"resp:{method}:{k}:unread"))
+    return findings
+
+
+def _enclosing_function(tree: ast.AST, target: ast.AST
+                        ) -> "ast.FunctionDef | None":
+    best = None
+    best_span = None
+    for node in ast.walk(tree):
+        if isinstance(node, ast.FunctionDef):
+            lo, hi = node.lineno, getattr(node, "end_lineno", node.lineno)
+            if lo <= target.lineno <= hi:
+                span = hi - lo
+                if best_span is None or span < best_span:
+                    best, best_span = node, span
+    return best

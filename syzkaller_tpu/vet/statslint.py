@@ -1,0 +1,136 @@
+"""Pass 5 — typed-stat-plane lint (relocated from presubmit.py).
+
+Two checks:
+
+  * P0 `raw-stats-access`: a `self.stats[...]` subscript outside
+    `telemetry/` — the stat plane is typed (telemetry/registry.py);
+    every increment must go through a registry metric or the StatsView
+    facade.  AST-based now, so mentions in strings/docstrings no longer
+    trip it (the old presubmit regex scanned raw lines).
+  * P0 `smoke-metric-unregistered`: every metric name the presubmit
+    telemetry smoke asserts (`_TELEMETRY_SMOKE`'s `for must in (...)`
+    tuple) must actually be registered somewhere — as a literal first
+    argument to `.counter()` / `.gauge()` / `.histogram()` / `.ewma()`,
+    or as an exposition name in telemetry/device.py's SCALAR_SLOTS /
+    HIST_SLOTS tables.  Catches the smoke test and the registry
+    drifting apart (the assertion would then fail only at presubmit
+    runtime, inside a subprocess, with a one-line message).
+"""
+
+from __future__ import annotations
+
+import ast
+
+from syzkaller_tpu.vet.core import P0, Finding, SourceFile, enclosing_scope
+
+EXEMPT_PARTS = ("telemetry",)
+REGISTRY_CTORS = {"counter", "gauge", "histogram", "ewma"}
+SLOT_TABLES = {"SCALAR_SLOTS", "HIST_SLOTS"}
+
+
+def _exempt(path: str) -> bool:
+    parts = path.replace("\\", "/").split("/")
+    return any(p in EXEMPT_PARTS for p in parts) \
+        or parts[-1] == "presubmit.py"
+
+
+def raw_stats_findings(files: list[SourceFile]) -> list[Finding]:
+    out: list[Finding] = []
+    for sf in files:
+        if _exempt(sf.path):
+            continue
+        for node in ast.walk(sf.tree):
+            if isinstance(node, ast.Subscript) \
+                    and isinstance(node.value, ast.Attribute) \
+                    and node.value.attr == "stats" \
+                    and isinstance(node.value.value, ast.Name) \
+                    and node.value.value.id == "self":
+                out.append(Finding(
+                    pass_name="stats", rule="raw-stats-access",
+                    severity=P0, path=sf.path, line=node.lineno,
+                    scope=enclosing_scope(sf.tree, node),
+                    message="raw self.stats[...] access outside "
+                            "telemetry/",
+                    hint="use a typed registry metric "
+                         "(telemetry/registry.py) or StatsView.bump()",
+                    detail=f"raw:{ast.unparse(node)[:40]}"))
+    return out
+
+
+def smoke_metric_names(files: list[SourceFile]) -> list[str]:
+    """Metric names asserted by presubmit's _TELEMETRY_SMOKE block."""
+    for sf in files:
+        if not sf.path.endswith("presubmit.py"):
+            continue
+        for node in ast.walk(sf.tree):
+            if isinstance(node, ast.Assign) and any(
+                    isinstance(t, ast.Name)
+                    and t.id == "_TELEMETRY_SMOKE" for t in node.targets) \
+                    and isinstance(node.value, ast.Constant) \
+                    and isinstance(node.value.value, str):
+                try:
+                    smoke = ast.parse(node.value.value)
+                except SyntaxError:
+                    return []
+                names: list[str] = []
+                for sub in ast.walk(smoke):
+                    if isinstance(sub, ast.For) \
+                            and isinstance(sub.iter, (ast.Tuple, ast.List)):
+                        for el in sub.iter.elts:
+                            if isinstance(el, ast.Constant) \
+                                    and isinstance(el.value, str) \
+                                    and el.value.startswith("syz_"):
+                                names.append(el.value)
+                return names
+    return []
+
+
+def registered_metric_names(files: list[SourceFile]) -> set[str]:
+    """Every metric name the tree registers: registry ctor literals +
+    the device stat vector's exposition tables."""
+    names: set[str] = set()
+    for sf in files:
+        for node in ast.walk(sf.tree):
+            if isinstance(node, ast.Call) \
+                    and isinstance(node.func, ast.Attribute) \
+                    and node.func.attr in REGISTRY_CTORS \
+                    and node.args \
+                    and isinstance(node.args[0], ast.Constant) \
+                    and isinstance(node.args[0].value, str):
+                names.add(node.args[0].value)
+            elif isinstance(node, ast.Assign) and any(
+                    isinstance(t, ast.Name) and t.id in SLOT_TABLES
+                    for t in node.targets):
+                for sub in ast.walk(node.value):
+                    if isinstance(sub, ast.Tuple) and len(sub.elts) >= 2 \
+                            and isinstance(sub.elts[1], ast.Constant) \
+                            and isinstance(sub.elts[1].value, str):
+                        names.add(sub.elts[1].value)
+    return names
+
+
+def smoke_findings(files: list[SourceFile]) -> list[Finding]:
+    asserted = smoke_metric_names(files)
+    if not asserted:
+        return []
+    registered = registered_metric_names(files)
+    out: list[Finding] = []
+    presubmit = next((sf for sf in files
+                      if sf.path.endswith("presubmit.py")), None)
+    path = presubmit.path if presubmit else "presubmit.py"
+    for name in asserted:
+        base = name.split("{")[0]
+        if base not in registered:
+            out.append(Finding(
+                pass_name="stats", rule="smoke-metric-unregistered",
+                severity=P0, path=path, line=1, scope="_TELEMETRY_SMOKE",
+                message=f"telemetry smoke asserts {name!r} but no "
+                        "registry/device-slot registration defines "
+                        f"{base!r}",
+                hint="register the metric or update the smoke list",
+                detail=f"smoke:{base}"))
+    return out
+
+
+def check(files: list[SourceFile]) -> list[Finding]:
+    return raw_stats_findings(files) + smoke_findings(files)

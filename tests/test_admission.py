@@ -145,6 +145,31 @@ def test_coalesced_matches_serial_replay(tmp_path):
         mgr_c.stop()
 
 
+def test_coalesced_admission_compile_count_pinned(tmp_path):
+    """Runtime companion to the vet retrace pass (vet/runtime.py): the
+    coalescer pads every dispatch to pow2-bucketed shapes (MIN_B/MIN_K),
+    so once the NewInput path is warm, admitting further inputs must
+    compile zero fresh XLA executables."""
+    from syzkaller_tpu.vet.runtime import CompileCounter
+
+    mgr = make_manager(8, tmp=str(tmp_path / "pin"))
+    inputs = make_inputs(24)
+    try:
+        for inp in inputs[:16]:            # warm every bucketed shape
+            p = dict(inp)
+            p["name"] = "vm0"
+            mgr.rpc_new_input(p)
+        with CompileCounter() as cc:
+            for inp in inputs[16:]:
+                p = dict(inp)
+                p["name"] = "vm0"
+                mgr.rpc_new_input(p)
+        assert len(mgr.corpus) == 24
+        assert cc.count == 0, cc.events
+    finally:
+        mgr.stop()
+
+
 def test_no_new_signal_rejected_and_counted():
     """An input whose cover is a subset of already-admitted signal is
     rejected through the coalescer, and counted."""

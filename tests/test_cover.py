@@ -405,6 +405,33 @@ def test_admit_batch_fused_choices(rng):
     assert len(rows) == 1
 
 
+def test_fused_dispatch_compile_counts_pinned(rng):
+    """Runtime companion to the vet retrace pass (vet/runtime.py):
+    once warmed at their bucketed shapes, the fused dense-update and
+    admission dispatches must not compile again — a shape leak or a
+    fresh per-call wrapper fails here before it becomes a production
+    compile treadmill."""
+    from syzkaller_tpu.vet.runtime import CompileCounter
+
+    eng = CoverageEngine(npcs=NPCS, ncalls=NCALLS, corpus_cap=64)
+    eng.set_enabled([1, 3, 5])
+    prev = np.full((32,), -1, np.int32)
+
+    def round(base):
+        covers = [sets.canonicalize(base + i * 64 + np.arange(24))
+                  for i in range(8)]
+        calls = np.array([1, 3, 5, 1, 3, 5, 1, 3], np.int32)
+        idx, valid = make_batch(covers)
+        np.asarray(eng.update_batch(calls, idx, valid).has_new)
+        np.asarray(eng.admit_batch(calls, idx, valid, prev)[0])
+
+    round(np.uint32(0))                     # warm: compiles once
+    with CompileCounter() as cc:
+        for k in range(1, 4):               # fresh covers, same shapes
+            round(np.uint32(k * 512))
+    assert cc.count == 0, cc.events
+
+
 def test_profiler_capture(tmp_path, engine, rng):
     """JAX profiler hook: a capture window around live engine work
     produces a tensorboard-loadable trace (SURVEY §5 step profiling)."""

@@ -1,0 +1,772 @@
+"""syz-vet tests: every pass catches its seeded violation and stays
+quiet on the idiomatic (fixed) form.
+
+The positive fixtures are not synthetic — each one encodes a bug class
+this repo actually shipped and fixed in the vet PR:
+
+  * sleep under a module lock        — utils/profiler.py capture()
+  * file I/O under the hub lock      — hub/state.py _save_manager
+  * socket connect under the client  — rpc.py RpcClient._call_locked
+    mutex
+  * device refill draw under the     — fuzzer/fuzzer.py _pick_corpus_row
+    proc-shared mutex (P1)
+  * per-call batch size into a       — manager/manager.py Poll choice
+    jitted draw (retrace)              top-up
+
+The matching negative fixture is the shape of the fix, so a regression
+of the fix pattern re-trips the pass."""
+
+import textwrap
+
+import numpy as np
+import pytest
+
+from syzkaller_tpu import vet
+from syzkaller_tpu.vet import core
+
+
+def run(src, passes, path="fixture.py"):
+    sf = vet.from_source(textwrap.dedent(src), path)
+    assert sf.error is None, sf.error
+    return core.run_passes([sf], passes=passes).findings
+
+
+def rules(findings):
+    return {f.rule for f in findings}
+
+
+# -- pass 1: lock discipline ------------------------------------------------
+
+
+def test_lock_sleep_under_module_lock_caught():
+    # profiler.py bug: the capture window slept out the trace duration
+    # while holding the one-capture-at-a-time lock
+    fs = run("""
+        import threading, time
+        _mu = threading.Lock()
+
+        def capture(seconds):
+            with _mu:
+                time.sleep(seconds)
+        """, ["lock"])
+    assert any(f.rule == "blocking-under-lock" and f.severity == vet.P0
+               and "time.sleep" in f.message for f in fs)
+
+
+def test_lock_sleep_outside_lock_clean():
+    # the fix shape: try-acquire, sleep outside any blocking hold
+    fs = run("""
+        import threading, time
+        _mu = threading.Lock()
+
+        def capture(seconds):
+            if not _mu.acquire(blocking=False):
+                return False
+            try:
+                time.sleep(seconds)
+            finally:
+                _mu.release()
+            return True
+        """, ["lock"])
+    # acquire(blocking=False) holds across the sleep but never blocks a
+    # contender — the pass only reconstructs `with` regions, so the
+    # explicit-acquire fix idiom is out of scope by design
+    assert not [f for f in fs if f.severity == vet.P0]
+
+
+def test_lock_file_io_under_lock_caught():
+    # hub/state.py bug: every manager's sync serialized on disk writes
+    # performed while the hub lock was held
+    fs = run("""
+        import json, threading
+
+        class Hub:
+            def __init__(self):
+                self._mu = threading.Lock()
+                self.state = {}
+
+            def sync(self, name, data):
+                with self._mu:
+                    self.state[name] = data
+                    with open("/state/" + name, "w") as f:
+                        json.dump(data, f)
+        """, ["lock"])
+    p0 = [f for f in fs if f.severity == vet.P0]
+    assert any("open" in f.message for f in p0)
+    assert any("json.dump" in f.message for f in p0)
+
+
+def test_lock_staged_writes_clean():
+    # the fix shape: mutate + stage under the lock, flush after release
+    fs = run("""
+        import threading
+
+        class Hub:
+            def __init__(self):
+                self._mu = threading.Lock()
+                self.state = {}
+                self._writes = []
+
+            def sync(self, name, data):
+                with self._mu:
+                    self.state[name] = data
+                    self._writes.append((name, data))
+                    writes, self._writes = self._writes, []
+                for name, data in writes:
+                    with open("/state/" + name, "w") as f:
+                        f.write(data)
+        """, ["lock"])
+    assert not [f for f in fs if f.severity == vet.P0]
+
+
+def test_lock_socket_connect_under_lock_caught():
+    # rpc.py bug: TCP establishment (full connect timeout) inside the
+    # call mutex stalled every other caller on the client
+    fs = run("""
+        import socket, threading
+
+        class Client:
+            def __init__(self, addr):
+                self.addr = addr
+                self._mu = threading.Lock()
+                self._sock = None
+
+            def call(self):
+                with self._mu:
+                    if self._sock is None:
+                        self._sock = socket.create_connection(self.addr)
+        """, ["lock"])
+    assert any(f.rule == "blocking-under-lock"
+               and "create_connection" in f.message for f in fs)
+
+
+def test_lock_connect_outside_lock_clean():
+    # the fix shape: connect unlocked, double-checked install
+    fs = run("""
+        import socket, threading
+
+        class Client:
+            def __init__(self, addr):
+                self.addr = addr
+                self._mu = threading.Lock()
+                self._sock = None
+
+            def call(self):
+                if self._sock is None:
+                    s = socket.create_connection(self.addr)
+                    with self._mu:
+                        if self._sock is None:
+                            self._sock = s
+        """, ["lock"])
+    assert not [f for f in fs if f.severity == vet.P0]
+
+
+def test_lock_blocking_in_called_helper_caught():
+    # one level of call-following: the blocking op hides in a helper
+    fs = run("""
+        import subprocess, threading
+
+        class Pool:
+            def __init__(self):
+                self._mu = threading.Lock()
+
+            def _spawn(self):
+                subprocess.run(["qemu"])
+
+            def take(self):
+                with self._mu:
+                    self._spawn()
+        """, ["lock"])
+    hit = [f for f in fs if f.rule == "blocking-under-lock"]
+    assert hit and "via Pool._spawn" in hit[0].message
+
+
+def test_lock_event_wait_under_lock_caught_condition_wait_clean():
+    fs = run("""
+        import threading
+
+        class W:
+            def __init__(self):
+                self._mu = threading.Lock()
+                self._cv = threading.Condition()
+                self._ev = threading.Event()
+
+            def bad(self):
+                with self._mu:
+                    self._ev.wait()       # does NOT release _mu
+
+            def good(self):
+                with self._cv:
+                    self._cv.wait()       # releases the held lock
+        """, ["lock"])
+    p0 = [f for f in fs if f.severity == vet.P0]
+    assert len(p0) == 1 and "self._ev.wait" in p0[0].message
+    assert p0[0].scope == "W.bad"
+
+
+def test_lock_order_cycle_caught():
+    fs = run("""
+        import threading
+
+        class AB:
+            def __init__(self):
+                self._a = threading.Lock()
+                self._b = threading.Lock()
+
+            def one(self):
+                with self._a:
+                    with self._b:
+                        pass
+
+            def two(self):
+                with self._b:
+                    with self._a:
+                        pass
+        """, ["lock"])
+    cyc = [f for f in fs if f.rule == "lock-order-cycle"]
+    assert cyc and cyc[0].severity == vet.P0
+    assert "AB._a" in cyc[0].message and "AB._b" in cyc[0].message
+
+
+def test_lock_consistent_order_clean():
+    fs = run("""
+        import threading
+
+        class AB:
+            def __init__(self):
+                self._a = threading.Lock()
+                self._b = threading.Lock()
+
+            def one(self):
+                with self._a:
+                    with self._b:
+                        pass
+
+            def two(self):
+                with self._a:
+                    with self._b:
+                        pass
+        """, ["lock"])
+    assert not [f for f in fs if f.rule == "lock-order-cycle"]
+
+
+def test_lock_device_refill_under_lock_is_p1():
+    # fuzzer.py _pick_corpus_row bug shape: the device-drawn refill ran
+    # under the proc-shared mutex — a warn (the engine's own
+    # serialization lock legitimately covers device work)
+    fs = run("""
+        import threading
+
+        class Sig:
+            def __init__(self, engine):
+                self._mu = threading.Lock()
+                self.engine = engine
+                self.rows = []
+
+            def refill(self):
+                with self._mu:
+                    if not self.rows:
+                        self.rows.extend(
+                            self.engine.sample_corpus_indices(256))
+        """, ["lock"])
+    hit = [f for f in fs if f.rule == "device-sync-under-lock"]
+    assert hit and hit[0].severity == vet.P1
+    assert not [f for f in fs if f.severity == vet.P0]
+
+
+# -- pass 2: device hot-path purity -----------------------------------------
+
+
+def test_purity_traced_branch_caught():
+    fs = run("""
+        import jax
+
+        @jax.jit
+        def step(x):
+            if x > 0:
+                return x
+            return -x
+        """, ["purity"])
+    assert any(f.rule == "traced-branch" and f.severity == vet.P0
+               for f in fs)
+
+
+def test_purity_jnp_where_clean():
+    fs = run("""
+        import jax
+        import jax.numpy as jnp
+
+        @jax.jit
+        def step(x):
+            return jnp.where(x > 0, x, -x)
+        """, ["purity"])
+    assert not fs
+
+
+def test_purity_static_argnums_branch_clean():
+    # branching on a static arg is trace-time specialization, not a
+    # tracer leak
+    fs = run("""
+        import functools
+        import jax
+
+        @functools.partial(jax.jit, static_argnums=(0,))
+        def step(mode, x):
+            if mode > 1:
+                return x * 2
+            return x
+        """, ["purity"])
+    assert not fs
+
+
+def test_purity_host_concretize_and_item_caught():
+    fs = run("""
+        import jax
+
+        @jax.jit
+        def step(x):
+            lo = float(x)
+            hi = x.item()
+            return lo + hi
+        """, ["purity"])
+    assert {"host-concretize", "host-sync"} <= rules(fs)
+
+
+def test_purity_numpy_on_tracer_caught_shape_escape_clean():
+    fs = run("""
+        import jax
+        import numpy as np
+        import jax.numpy as jnp
+
+        @jax.jit
+        def bad(x):
+            return np.sum(x)
+
+        @jax.jit
+        def good(x):
+            n = x.shape[0]            # shape space: static under jit
+            return jnp.zeros((n,)) + x
+        """, ["purity"])
+    assert rules(fs) == {"numpy-on-tracer"}
+    assert all(f.scope == "bad" for f in fs)
+
+
+def test_purity_taint_follows_callee():
+    # the jitted root is clean; the helper it hands the tracer to isn't
+    fs = run("""
+        import jax
+
+        @jax.jit
+        def root(x):
+            return helper(x)
+
+        def helper(v):
+            if v > 0:
+                return v
+            return -v
+        """, ["purity"])
+    hit = [f for f in fs if f.rule == "traced-branch"]
+    assert hit and hit[0].scope == "helper"
+
+
+def test_purity_lax_cond_body_analyzed():
+    fs = run("""
+        import jax
+        from jax import lax
+
+        @jax.jit
+        def root(x):
+            return lax.cond(x[0] > 0, branch, branch, x)
+
+        def branch(v):
+            return float(v)
+        """, ["purity"])
+    assert any(f.rule == "host-concretize" and f.scope == "branch"
+               for f in fs)
+
+
+# -- pass 3: retrace hazards ------------------------------------------------
+
+
+def test_retrace_raw_len_shape_caught():
+    # manager.py Poll top-up bug shape: a jitted draw sized by the
+    # request's fill level compiles one executable per distinct size
+    fs = run("""
+        import numpy as np
+
+        class Mgr:
+            def topup(self, choices, want):
+                short = want - len(choices)
+                draws = self.engine.sample_next_calls(
+                    np.full((short,), -1, np.int32))
+                return draws
+        """, ["retrace"])
+    hit = [f for f in fs if f.rule == "unbucketed-shape"]
+    assert hit and hit[0].severity == vet.P1
+    assert "short" in hit[0].message
+
+
+def test_retrace_pow2_bucketed_shape_clean():
+    # the coalescer idiom: route the raw size through pow2_bucket
+    fs = run("""
+        import numpy as np
+        from syzkaller_tpu.utils.shapes import pow2_bucket
+
+        class Mgr:
+            def admit(self, batch):
+                n = pow2_bucket(len(batch), 8, 128)
+                ids = np.zeros((n,), np.int32)
+                return self._gate_fn(ids)
+        """, ["retrace"])
+    assert not [f for f in fs if f.rule == "unbucketed-shape"]
+
+
+def test_retrace_fixed_draw_and_slice_clean():
+    # the manager fix shape: full-batch draw, host-side slice
+    fs = run("""
+        import numpy as np
+
+        WANT = 64
+
+        class Mgr:
+            def topup(self, choices):
+                short = WANT - len(choices)
+                draws = self.engine.sample_next_calls(
+                    np.full((WANT,), -1, np.int32))
+                return draws[:short]
+        """, ["retrace"])
+    assert not [f for f in fs if f.rule == "unbucketed-shape"]
+
+
+def test_retrace_unhashable_static_caught():
+    fs = run("""
+        import jax
+
+        def kernel(x, spec):
+            return x
+
+        kernel_fn = jax.jit(kernel, static_argnums=(1,))
+
+        def go(x):
+            return kernel(x, [1, 2, 3])
+        """, ["retrace"])
+    hit = [f for f in fs if f.rule == "unhashable-static"]
+    assert hit and hit[0].severity == vet.P0
+    assert "position 1" in hit[0].message
+
+
+def test_retrace_hashable_static_clean():
+    fs = run("""
+        import jax
+
+        def kernel(x, spec):
+            return x
+
+        kernel_fn = jax.jit(kernel, static_argnums=(1,))
+
+        def go(x):
+            return kernel(x, (1, 2, 3))
+        """, ["retrace"])
+    assert not [f for f in fs if f.rule == "unhashable-static"]
+
+
+def test_retrace_jit_per_call_caught():
+    fs = run("""
+        import jax
+
+        def hot(x):
+            return jax.jit(lambda y: y + 1)(x)
+        """, ["retrace"])
+    hit = [f for f in fs if f.rule == "jit-per-call"]
+    assert hit and "lambda" in hit[0].message
+
+
+def test_retrace_module_scope_jit_clean():
+    fs = run("""
+        import jax
+
+        def _step(y):
+            return y + 1
+
+        step_fn = jax.jit(_step)
+
+        def hot(x):
+            return step_fn(x)
+        """, ["retrace"])
+    assert not [f for f in fs if f.rule == "jit-per-call"]
+
+
+# -- pass 4: RPC schema drift -----------------------------------------------
+
+MGR_FIXTURE = """
+class Manager:
+    def __init__(self, server):
+        server.register("Manager.Poll", self.rpc_poll)
+        server.register("Manager.Connect", self.rpc_connect)
+
+    def rpc_poll(self, params):
+        name = params["name"]
+        need = params.get("need_flakes")
+        return {"progs": [], "choices": []}
+
+    def rpc_connect(self, params):
+        who = params["auth"]
+        return {}
+"""
+
+FZ_FIXTURE = """
+class Fuzzer:
+    def loop(self):
+        self.client.call("Manager.Connect", {"name": self.name})
+        r = self.client.call("Manager.Poll", {"name": self.name})
+        progs = r["progs"]
+        ghost = r["gone"]
+        self.client.call("Manager.Vanish", {"name": self.name})
+"""
+
+
+def schema_findings():
+    files = [vet.from_source(MGR_FIXTURE, "manager.py"),
+             vet.from_source(FZ_FIXTURE, "fuzzer.py")]
+    return core.run_passes(files, passes=["schema"]).findings
+
+
+def test_schema_drift_caught():
+    fs = schema_findings()
+    by_rule = {}
+    for f in fs:
+        by_rule.setdefault(f.rule, []).append(f)
+    # called but never registered
+    assert any(f.scope == "Manager.Vanish" and f.severity == vet.P0
+               for f in by_rule["unregistered-method"])
+    # handler hard-requires params["auth"]; no call site writes it
+    assert any(f.scope == "Manager.Connect" and f.severity == vet.P0
+               and "'auth'" in f.message
+               for f in by_rule["param-never-written"])
+    # optional read with no writer is a warn, not a block
+    assert any(f.scope == "Manager.Poll" and f.severity == vet.P1
+               and "need_flakes" in f.message
+               for f in by_rule["param-never-written"])
+    # caller requires a response key the handler never returns
+    assert any(f.scope == "Manager.Poll" and f.severity == vet.P0
+               and "'gone'" in f.message
+               for f in by_rule["response-drift"])
+    # handler returns "choices" that nobody reads: warn
+    assert any(f.severity == vet.P1 and "'choices'" in f.message
+               for f in by_rule["response-drift"])
+
+
+def test_schema_symmetric_clean():
+    mgr = """
+class Manager:
+    def __init__(self, server):
+        server.register("Manager.Poll", self.rpc_poll)
+
+    def rpc_poll(self, params):
+        name = params["name"]
+        return {"progs": []}
+"""
+    fz = """
+class Fuzzer:
+    def loop(self):
+        r = self.client.call("Manager.Poll", {"name": self.name})
+        return r["progs"]
+"""
+    files = [vet.from_source(mgr, "manager.py"),
+             vet.from_source(fz, "fuzzer.py")]
+    assert not core.run_passes(files, passes=["schema"]).findings
+
+
+def test_schema_opaque_params_skip_key_checks():
+    # a non-literal params dict makes write-side checks unsound; the
+    # pass must stay quiet rather than guess
+    mgr = """
+class Manager:
+    def __init__(self, server):
+        server.register("Manager.Poll", self.rpc_poll)
+
+    def rpc_poll(self, params):
+        return {"progs": params["name"]}
+"""
+    fz = """
+class Fuzzer:
+    def loop(self):
+        p = self.build_params()
+        self.client.call("Manager.Poll", p)
+"""
+    files = [vet.from_source(mgr, "manager.py"),
+             vet.from_source(fz, "fuzzer.py")]
+    fs = core.run_passes(files, passes=["schema"]).findings
+    assert not [f for f in fs if f.rule == "param-never-written"]
+
+
+# -- pass 5: stats lint -----------------------------------------------------
+
+
+def test_stats_raw_access_caught_and_telemetry_exempt():
+    src = """
+class Manager:
+    def bump(self):
+        self.stats["execs"] += 1
+"""
+    fs = core.run_passes(
+        [vet.from_source(src, "manager/foo.py")], passes=["stats"]).findings
+    assert rules(fs) == {"raw-stats-access"}
+    assert fs[0].severity == vet.P0
+    fs = core.run_passes(
+        [vet.from_source(src, "telemetry/view.py")],
+        passes=["stats"]).findings
+    assert not fs
+
+
+def test_stats_docstring_mention_not_flagged():
+    # the old presubmit regex tripped on mentions in strings; the AST
+    # lint must not
+    src = '''
+class Manager:
+    """Never write self.stats["x"] directly."""
+    note = "self.stats[...] is banned"
+'''
+    fs = core.run_passes(
+        [vet.from_source(src, "manager/foo.py")], passes=["stats"]).findings
+    assert not fs
+
+
+SMOKE_FIXTURE = '''
+_TELEMETRY_SMOKE = r"""
+for must in ("syz_widget_total",):
+    assert must in series
+"""
+'''
+
+
+def test_stats_smoke_metric_unregistered_caught():
+    fs = core.run_passes(
+        [vet.from_source(SMOKE_FIXTURE, "presubmit.py")],
+        passes=["stats"]).findings
+    assert rules(fs) == {"smoke-metric-unregistered"}
+    assert "syz_widget_total" in fs[0].message
+
+
+def test_stats_smoke_metric_registered_clean():
+    reg = """
+class Telemetry:
+    def __init__(self, reg):
+        self._c = reg.counter("syz_widget_total", "a widget counter")
+"""
+    files = [vet.from_source(SMOKE_FIXTURE, "presubmit.py"),
+             vet.from_source(reg, "manager/foo.py")]
+    assert not core.run_passes(files, passes=["stats"]).findings
+
+
+# -- baseline ---------------------------------------------------------------
+
+
+def test_baseline_suppresses_justified_p0(tmp_path):
+    src = """
+import threading, time
+_mu = threading.Lock()
+
+def capture(seconds):
+    with _mu:
+        time.sleep(seconds)
+"""
+    sf = vet.from_source(src, "fixture.py")
+    rep = core.run_passes([sf], passes=["lock"])
+    (ident,) = {f.ident for f in rep.p0_unbaselined}
+    bl = tmp_path / "baseline.txt"
+    bl.write_text(f"{ident}  # capture window is the protected op\n"
+                  "stale:entry  # no longer fires\n")
+    stale = vet.apply_baseline(rep.findings, vet.load_baseline(str(bl)))
+    assert not rep.p0_unbaselined
+    assert stale == ["stale:entry"]
+
+
+def test_baseline_requires_justification(tmp_path):
+    bl = tmp_path / "baseline.txt"
+    bl.write_text("lock:foo.py:f:blocking-under-lock:x\n")
+    with pytest.raises(ValueError, match="justification"):
+        vet.load_baseline(str(bl))
+
+
+def test_finding_ident_survives_line_moves():
+    a = vet.from_source("""
+import threading, time
+_mu = threading.Lock()
+
+def capture(seconds):
+    with _mu:
+        time.sleep(seconds)
+""", "fixture.py")
+    b = vet.from_source("""
+import threading, time
+
+# a comment pushing everything down
+
+
+_mu = threading.Lock()
+
+def capture(seconds):
+    with _mu:
+        time.sleep(seconds)
+""", "fixture.py")
+    fa = core.run_passes([a], passes=["lock"]).findings
+    fb = core.run_passes([b], passes=["lock"]).findings
+    assert {f.ident for f in fa} == {f.ident for f in fb}
+    assert {f.line for f in fa} != {f.line for f in fb}
+
+
+# -- the gate itself --------------------------------------------------------
+
+
+def test_vet_self_clean():
+    """The analyzer runs over the real tree with zero unbaselined P0s —
+    the acceptance bar for every future PR."""
+    rep = vet.run_repo()
+    assert not rep.parse_errors, rep.parse_errors
+    assert not rep.p0_unbaselined, "\n".join(
+        f.render() for f in rep.p0_unbaselined)
+
+
+def test_vet_cli_json(capsys):
+    from syzkaller_tpu.vet.__main__ import main
+
+    rc = main(["--json"])
+    out = capsys.readouterr().out
+    import json
+
+    rep = json.loads(out)
+    assert rc == 0
+    assert rep["ok"] is True
+    assert rep["counts"]["p0_unbaselined"] == 0
+    assert set(rep["counts"]["by_pass"]) <= {
+        "lock", "purity", "retrace", "schema", "stats"}
+
+
+def test_parse_error_blocks_gate(tmp_path):
+    bad = tmp_path / "bad.py"
+    bad.write_text("def broken(:\n")
+    files = core.collect_files([str(bad)], root=str(tmp_path))
+    rep = core.run_passes(files)
+    assert rep.parse_errors and not rep.to_json()["ok"]
+
+
+# -- runtime companion: CompileCounter --------------------------------------
+
+
+def test_compile_counter_counts_fresh_and_cached():
+    import jax
+    import jax.numpy as jnp
+
+    from syzkaller_tpu.vet.runtime import CompileCounter
+
+    f = jax.jit(lambda x: x * 2 + 1)
+    with CompileCounter() as cc:
+        jax.block_until_ready(f(jnp.ones((16,))))
+    assert cc.count >= 1                  # cold: at least one compile
+    with CompileCounter() as cc:
+        jax.block_until_ready(f(jnp.ones((16,))))
+    assert cc.count == 0                  # warm same shape: cached
+    with CompileCounter() as cc:
+        jax.block_until_ready(f(jnp.ones((32,))))
+    assert cc.count >= 1                  # new shape: retrace

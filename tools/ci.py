@@ -1,0 +1,71 @@
+#!/usr/bin/env python3
+"""CI driver: machine-readable static-analysis gate.
+
+Runs `python -m syzkaller_tpu.vet --json`, surfaces per-pass finding
+counts in a short human summary (and the raw JSON with --raw), and
+exits with vet's status — unbaselined P0s or parse errors fail the job.
+With --full it then runs the whole presubmit gate (which re-runs vet as
+its first analysis step, plus build/tests/smokes).
+
+    python tools/ci.py [--raw] [--full]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def run_vet() -> tuple[int, dict]:
+    r = subprocess.run(
+        [sys.executable, "-m", "syzkaller_tpu.vet", "--json"],
+        cwd=ROOT, capture_output=True, text=True)
+    if not r.stdout.strip():
+        sys.stderr.write(r.stderr)
+        raise SystemExit(f"vet produced no JSON (rc={r.returncode})")
+    return r.returncode, json.loads(r.stdout)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--raw", action="store_true",
+                    help="also print vet's raw JSON report")
+    ap.add_argument("--full", action="store_true",
+                    help="run the full presubmit gate after vet")
+    args = ap.parse_args(argv)
+
+    rc, rep = run_vet()
+    c = rep["counts"]
+    print(f"[ci] vet: {c['total']} finding(s) — "
+          f"{c['p0']} P0 ({c['p0_unbaselined']} unbaselined), "
+          f"{c['p1']} P1, {c['baselined']} baselined")
+    for name in sorted(c.get("by_pass", {})):
+        print(f"[ci]   {name:8s} {c['by_pass'][name]}")
+    for err in rep.get("parse_errors", []):
+        print(f"[ci]   parse error: {err}")
+    for ident in rep.get("stale_baseline", []):
+        print(f"[ci]   stale baseline entry: {ident}")
+    if args.raw:
+        print(json.dumps(rep, indent=2, sort_keys=True))
+    if rc != 0:
+        print("[ci] FAIL: vet gate (unbaselined P0s or parse errors)")
+        return rc
+
+    if args.full:
+        r = subprocess.run(
+            [sys.executable, "-m", "syzkaller_tpu.presubmit"], cwd=ROOT)
+        if r.returncode != 0:
+            print(f"[ci] FAIL: presubmit ({r.returncode})")
+            return r.returncode
+
+    print("[ci] PASS")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
