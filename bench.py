@@ -376,6 +376,83 @@ def bench_device_sparse(call_ids, pc_idx, valid, npcs, block_words=2,
     return b * steps_per_call * calls / dt
 
 
+def bench_decision_stream(seconds=SECONDS, smoke=False):
+    """The fused decision-stream path vs the 430-510k/s legacy draw
+    metric (`choice_draws_per_sec` in bench_corpus_scale, kept for
+    trajectory continuity): one megakernel dispatch emits per-context
+    choice draws for EVERY prev row + the hot-row extension + corpus
+    picks + an entropy slab, with the PRNG key donated on device and
+    zero host operands moving in.  Measured two ways: (a) raw pipelined
+    production — dispatch block N+1, resolve block N (the double-buffer
+    the prefetcher runs), draws per wall-second; (b) consumer health —
+    threads hammering choose() through the live prefetcher, reporting
+    the underrun rate (ring misses that fell back to a direct draw)."""
+    import threading as _threading
+
+    from syzkaller_tpu.cover.engine import CoverageEngine
+    from syzkaller_tpu.fuzzer.device_ct import DecisionStream
+
+    eng = CoverageEngine(npcs=NPCS, ncalls=NCALLS, corpus_cap=256,
+                         batch=64, max_pcs_per_exec=K)
+    per_row = 64 if smoke else 256
+    hot = 128 if smoke else 2048
+    stream = DecisionStream(eng, per_row=per_row, hot_slots=hot,
+                            corpus_rows=64 if smoke else 256,
+                            entropy_words=1024 if smoke else 1 << 13,
+                            warm_after=0, autostart=False)
+    # (a) raw production rate, double-buffered, value-fetch barriers
+    with stream._mu:
+        hot_dev = stream._hot_dev
+    blk = eng.decision_block(hot_dev, stream.per_row, stream.n_rows,
+                             stream.n_entropy)
+    np.asarray(blk.base)                 # compile + warm, real barrier
+    calls = 0
+    prev_blk = None
+    t0 = time.perf_counter()
+    while time.perf_counter() - t0 < seconds:
+        nxt = eng.decision_block(hot_dev, stream.per_row, stream.n_rows,
+                                 stream.n_entropy)
+        if prev_blk is not None:
+            np.asarray(prev_blk.base)    # resolve N while N+1 runs
+        prev_blk = nxt
+        calls += 1
+    np.asarray(prev_blk.base)
+    dt = time.perf_counter() - t0
+    fused_rate = stream.draws_per_block * calls / dt
+
+    # (b) the live prefetcher under consumer load (this is also the
+    # --smoke exercise of the async refill/invalidate lifecycle)
+    live = DecisionStream(eng, per_row=per_row, hot_slots=hot,
+                          corpus_rows=64 if smoke else 256,
+                          entropy_words=1024 if smoke else 1 << 13,
+                          warm_after=0)
+    live.refill_once()                   # warm ring before the clock
+    run_s = 0.25 if smoke else 1.0
+    stop_at = time.perf_counter() + run_s
+    prevs = [-1, 0, 1, 2, 3]
+
+    def consume(k):
+        i = 0
+        while time.perf_counter() < stop_at:
+            live.choose(prev_call_id=prevs[(i + k) % len(prevs)])
+            i += 1
+
+    ts = [_threading.Thread(target=consume, args=(k,))
+          for k in range(2 if smoke else 4)]
+    for t in ts:
+        t.start()
+    live.invalidate()                    # mid-storm eager redraw
+    for t in ts:
+        t.join()
+    served, under = live.stat_served, live.stat_underruns
+    live.stop()
+    return {
+        "choice_draws_per_sec_fused": round(fused_rate, 1),
+        "choice_stream_underrun_rate": round(under / max(served, 1), 4),
+        "choice_stream_blocks": live.stat_blocks,
+    }
+
+
 def bench_admission(n_inputs=1536, nthreads=48, admit_batch=64, npcs=NPCS):
     """Batched admission through the manager coalescer vs the old
     serial per-input rpc_new_input path: N handler threads fire
@@ -572,6 +649,9 @@ def main(argv=None):
     _stage("corpus scale")
     extras.update(bench_corpus_scale(np.random.default_rng(13),
                                      C=2048 if args.smoke else 100_000))
+    _stage("decision stream")
+    extras.update(bench_decision_stream(
+        seconds=0.5 if args.smoke else 2.0, smoke=args.smoke))
     # static-analysis gate trajectory: the BENCH_*.json series records
     # the vet finding counts alongside throughput, so a PR that buys
     # speed by parking P0s in the baseline shows up in the history

@@ -394,6 +394,33 @@ def sample_calls(key: jax.Array, probs: jax.Array, prev: jax.Array,
     return jnp.minimum(idx, last_ok)
 
 
+def sample_calls_rows(key: jax.Array, probs: jax.Array, enabled: jax.Array,
+                      per_row: int) -> jax.Array:
+    """All-contexts ChoiceTable draw: per_row samples for EVERY previous-
+    call context in one shot — row 0 is the no-context (-1) row, row r+1
+    conditions on prev call r.  Returns (C+1, per_row) int32 draws.
+
+    This is the decision-stream formulation of `sample_calls`: that path
+    gathers a cdf row PER DRAW (O(3C) work each — gather + cumsum +
+    compare dominated the ~500k/s legacy draw rate), while here the
+    (C+1, C) cdf matrix is materialized ONCE and every draw is one
+    uniform plus a vectorized binary search (O(log C)).  Distribution is
+    identical: prefix-cdf with side='right' selection means interior
+    zero-weight (disabled) slots have flat cdf runs and cannot be
+    selected, and the same last-nonzero clamp absorbs f32 round-up to
+    the row total."""
+    C = probs.shape[0]
+    rows = jnp.concatenate([jnp.ones((1, C), probs.dtype), probs], axis=0)
+    w = jnp.where(enabled[None, :], rows, 0.0)
+    cdf = jnp.cumsum(w, axis=1)
+    u = jax.random.uniform(key, (C + 1, per_row)) * cdf[:, -1:]
+    idx = jax.vmap(
+        lambda c, uu: jnp.searchsorted(c, uu, side="right"))(cdf, u)
+    last_ok = C - 1 - jnp.argmax((w > 0)[:, ::-1], axis=1)
+    return jnp.minimum(idx.astype(jnp.int32),
+                       last_ok[:, None].astype(jnp.int32))
+
+
 def dynamic_prios(call_matrix: jax.Array) -> jax.Array:
     """(C, N) multi-hot corpus occurrence → (N, N) dampened co-occurrence.
     One MXU matmul replaces the reference's pairwise Python/Go loops."""
@@ -458,6 +485,19 @@ class UpdateResult:
 
 
 @dataclass
+class DecisionBlock:
+    """One decision-stream megakernel emission — every field is a
+    device array the caller fetches later (JAX async dispatch), so the
+    dispatch itself never blocks the issuing thread."""
+    base: jax.Array         # (ncalls+1, per_row) int32 choice draws;
+    #                         row r conditions on prev call r-1
+    hot: jax.Array          # (H,) int32 draws for the adaptive hot-row
+    #                         prev composition (cached device operand)
+    corpus_rows: jax.Array  # (n_rows,) int32 signal-weighted corpus picks
+    entropy: jax.Array      # (2, n_entropy) uint32 halves → uint64 words
+
+
+@dataclass
 class SparseUpdateResult:
     has_new: jax.Array          # (B,) device bool — fetch with np.asarray
     new_bits: jax.Array         # (B, MB*block_words) block-LOCAL diffs,
@@ -505,6 +545,10 @@ class CoverageEngine:
                 max_touched_blocks = 0      # sparse wouldn't be narrower
         self.max_touched_blocks = max_touched_blocks
         self.key = jax.random.PRNGKey(seed)
+        # the decision stream's own key chain: carried through the
+        # megakernel via a donated buffer so refills move zero host
+        # operands (split off the main chain lazily on first block)
+        self._ds_key: "jax.Array | None" = None
         self._key_mu = threading.Lock()
         self._state_mu = threading.RLock()
 
@@ -712,6 +756,43 @@ class CoverageEngine:
         def _random_bits(key, n):
             return jax.random.bits(key, (2, n), dtype=jnp.uint32)
 
+        ncalls = self.ncalls
+
+        @functools.partial(jax.jit, donate_argnums=(0,),
+                           static_argnums=(7, 8, 9))
+        def _decision(key, prios, enabled, corpus_mat, hot_prev, svec,
+                      hinc, per_row, n_rows, n_entropy):
+            """The decision-stream megakernel: ONE dispatch emits a
+            structured decision block — per-context choice-table draws
+            for every prev row (cdf materialized once, draws are
+            vectorized binary searches), a hot-row extension over the
+            adaptive prev composition, a batch of signal-weighted
+            corpus-row picks, and a slab of raw entropy for Rand.refill.
+            The PRNG key is donated: steady-state refills move no host
+            operands in (prios/enabled/corpus_mat/hot_prev are already
+            device-resident) and the ring-refill stats are bumped in
+            place on the device stat vector."""
+            key, k1, k2, k3, k4 = jax.random.split(key, 5)
+            base = sample_calls_rows(k1, prios, enabled, per_row)
+            hot = sample_calls(k2, prios, hot_prev, enabled)
+            wts = popcount_rows(corpus_mat)
+            logits = jnp.where(wts > 0,
+                               jnp.log(wts.astype(jnp.float32)), -jnp.inf)
+            # empty corpus: flat logits keep categorical finite; the
+            # host consumer drops rows >= corpus_len anyway
+            logits = jnp.where(jnp.any(wts > 0), logits,
+                               jnp.zeros_like(logits))
+            crows = jax.random.categorical(
+                k3, logits[None, :], axis=-1,
+                shape=(1, n_rows))[0].astype(jnp.int32)
+            ent = jax.random.bits(k4, (2, n_entropy), dtype=jnp.uint32)
+            if ds is not None:
+                svec = svec + hinc
+                svec = svec.at[ds.slot("ring_refill")].add(1)
+                svec = svec.at[ds.slot("ring_draws")].add(
+                    jnp.int32((ncalls + 1) * per_row + hot_prev.shape[0]))
+            return key, base, hot, crows, ent, svec
+
         @jax.jit
         def _popcount(mat):
             return popcount_rows(mat)
@@ -769,6 +850,7 @@ class CoverageEngine:
             return mc, hn
 
         self._random_bits_fn = _random_bits
+        self._decision_fn = _decision
         self._popcount_fn = _popcount
         self._pack_fn = _pack
         self._pack_or_fn = _pack_or
@@ -1127,6 +1209,33 @@ class CoverageEngine:
         sub = self._next_key()
         prev = jnp.asarray(prev_call_ids, jnp.int32)
         return np.asarray(self._sample_fn(sub, self.prios, prev, self.enabled))
+
+    def put_replicated(self, arr) -> jax.Array:
+        """Place a small dispatch operand on the engine's device(s)
+        (replicated under a mesh) so callers can cache it and
+        steady-state dispatches move zero host operands in."""
+        a = jnp.asarray(arr)
+        if self.mesh is not None:
+            a = jax.device_put(a, NamedSharding(self.mesh, P()))
+        return a
+
+    @_locked
+    def decision_block(self, hot_prev: jax.Array, per_row: int,
+                       n_rows: int, n_entropy: int) -> DecisionBlock:
+        """Dispatch ONE decision-stream megakernel step (async — the
+        returned block's fields are device arrays the caller fetches
+        later).  `hot_prev` must be a device-cached int32 composition
+        (put_replicated); per_row/n_rows/n_entropy are static dispatch
+        shapes the caller keeps in a pow2-bucketed closed set."""
+        svec, hinc = self._ts_in()
+        if self._ds_key is None:
+            self._ds_key = self._next_key()
+        (self._ds_key, base, hot, crows, ent, svec) = self._decision_fn(
+            self._ds_key, self.prios, self.enabled, self.corpus_mat,
+            hot_prev, svec, hinc, per_row, n_rows, n_entropy)
+        self._ts_out(svec)
+        return DecisionBlock(base=base, hot=hot, corpus_rows=crows,
+                             entropy=ent)
 
     def random_words(self, n: int) -> np.ndarray:
         return _combine_words(self._random_bits_fn(self._next_key(), n))

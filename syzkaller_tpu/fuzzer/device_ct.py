@@ -1,55 +1,415 @@
-"""Device-backed ChoiceTable adapter.
+"""Device-backed decision stream: the fused async sampling plane.
 
-Bridges the per-decision interface the program generator wants
-(choose(rand, prev) — ref prog/prio.go:230) to batched device sampling:
-one jit call draws a whole batch of decisions conditioned on the same
-previous call, cached and handed out one by one. This is the
-"amortize the device round-trip" pattern from SURVEY §7.
+The old DeviceChoiceTable blocked every choose() caller behind a
+synchronous refill dispatch under one lock and drew nothing but call
+choices — corpus-row picks and Rand entropy refills were separate
+dispatches on separate paths.  This module replaces all three with ONE
+decision-stream megakernel (cover/engine.py `decision_block`) consumed
+through a double-buffered async prefetcher:
+
+  * each block carries per-context choice draws for EVERY prev row, a
+    hot-row extension, a batch of signal-weighted corpus-row picks, and
+    a slab of raw uint64 entropy — the "amortize the device round-trip"
+    pattern from SURVEY §7 taken to its fixed point;
+  * a background thread dispatches block N+1 while consumers drain
+    block N (JAX async dispatch hides the tunnel latency), so choose()
+    is a deque pop, never a device wait;
+  * per-row ring targets adapt to telemetry-observed drain rates: hot
+    rows earn slots in the block's hot-prev composition (a cached
+    device operand, re-uploaded only when the allocation shifts —
+    steady-state refills move zero host operands in);
+  * invalidate() (on priority-matrix / enabled-set updates) bumps an
+    epoch that discards in-flight stale blocks and kicks an EAGER
+    background redraw, instead of making the next choose() eat the full
+    cold-refill latency;
+  * a ring miss (underrun) falls back to one fixed-shape direct draw
+    outside every lock — consumers never block on the prefetcher, so an
+    invalidation storm cannot deadlock the draw path.
+
+Lock discipline (syz-vet): `_mu` guards ring state only — device
+dispatches, host syncs (np.asarray) and the prefetcher condition are
+always taken OUTSIDE it, and the two locks are never nested.
 """
 
 from __future__ import annotations
 
 import threading
+import time
 from collections import deque
 
 import numpy as np
 
+from syzkaller_tpu.utils import log
+from syzkaller_tpu.utils.shapes import pow2_bucket
 
-class DeviceChoiceTable:
-    """Thread-safe: stress/fuzzer proc threads share one instance."""
 
-    def __init__(self, engine, per_row: int = 64):
+class DecisionStream:
+    """Thread-safe decision-block consumer plane over a CoverageEngine.
+
+    Consumers: `choose()` / `take()` (choice draws per prev context),
+    `next_corpus_row()` (signal-weighted mutation picks), and
+    `take_entropy()` (uint64 slabs for prog.rand.Rand.refill).
+    """
+
+    # fixed-shape direct-draw batch for ring underruns (one compiled
+    # sampling kernel, reused by every miss)
+    UNDERRUN_BATCH = 64
+
+    def __init__(self, engine, per_row: int = 64, hot_slots: int = 1024,
+                 corpus_rows: int = 256, entropy_words: int = 1 << 13,
+                 ring_mult: int = 4, adapt_every: int = 4,
+                 warm_after: int = 2, telemetry=None,
+                 autostart: bool = True):
         self.engine = engine
-        self.per_row = per_row
-        self._cache: dict[int, deque] = {}
+        self.tstats = telemetry if telemetry is not None else engine.tstats
+        # dispatch shapes live in a pow2-bucketed closed set: the
+        # megakernel compiles once per (per_row, H, n_rows, n_entropy)
+        # and ring-size adaptation only changes OPERAND CONTENTS
+        self.per_row = pow2_bucket(per_row, 8, 1024)
+        self.hot_slots = pow2_bucket(hot_slots, 64, 1 << 14)
+        self.n_rows = pow2_bucket(corpus_rows, 32, 1 << 12)
+        self.n_entropy = pow2_bucket(entropy_words, 1024, 1 << 16)
+        self.ring_mult = ring_mult
+        self.adapt_every = adapt_every
+        # the prefetcher engages only after this many direct fallback
+        # dispatches: cold one-shot consumers (a single Poll, unit
+        # tests) keep paying the cheap direct path instead of compiling
+        # the megakernel for draws nobody will drain
+        self.warm_after = warm_after
+        self._R = engine.ncalls + 1          # prev contexts incl. -1
+        self.draws_per_block = self._R * self.per_row + self.hot_slots
+
+        # ring state — guarded by _mu, never held across device work
         self._mu = threading.Lock()
+        self._rings: dict[int, deque] = {}
+        self._crows: deque = deque()
+        self._ent: deque = deque()           # np.uint64 slabs
+        self._ent_len = 0
+        self._inv_total = 0
+        self._epoch = 0
+        self._drained = np.zeros((self._R,), np.int64)
+        self._targets = np.full((self._R,), self.per_row, np.int64)
+        self._targets[0] += self.hot_slots   # initial hot composition: -1
+        self._hot_host = np.full((self.hot_slots,), -1, np.int32)
+        self._hot_dev = engine.put_replicated(self._hot_host)
+        self._warmed = False
+        self._starved = False
+        # health counters (host-side; the device stat vector carries the
+        # exposition series)
+        self.stat_served = 0
+        self.stat_underruns = 0
+        self.stat_blocks = 0
+        self.stat_discarded = 0
+        self._direct_dispatches = 0
+        self._last_adapt = 0
 
-    def _refill_all(self) -> None:
-        """ONE device call draws `per_row` decisions for every possible
-        previous call (plus the no-context row): (ncalls+1)*per_row
-        categorical draws, amortizing tunnel latency over thousands of
-        choose() calls.  Rows that still hold unused draws keep them
-        (topped up, never discarded) so hot rows draining doesn't throw
-        away the cold rows' cache."""
-        n = self.engine.ncalls
-        prev = np.repeat(np.arange(-1, n, dtype=np.int32), self.per_row)
-        draws = self.engine.sample_next_calls(prev)
-        for row in range(-1, n):
-            lo = (row + 1) * self.per_row
-            q = self._cache.setdefault(row, deque())
-            need = self.per_row - len(q)
-            if need > 0:
-                q.extend(int(x) for x in draws[lo: lo + need])
+        # prefetcher control — its own condition lock; _mu and _cv are
+        # NEVER nested (no lock-order edge either way)
+        self._cv = threading.Condition(threading.Lock())
+        self._kicked = False
+        self._stop = False
+        self._inflight = None
+        self._thread: "threading.Thread | None" = None
+        if autostart:
+            self._thread = threading.Thread(
+                target=self._loop, name="decision-stream", daemon=True)
+            self._thread.start()
 
-    def choose(self, r, prev_call_id: int = -1) -> int:
+    # -- consumer side -----------------------------------------------------
+
+    def choose(self, r=None, prev_call_id: int = -1) -> int:
+        """One ChoiceTable decision conditioned on prev_call_id (-1 = no
+        context).  Fast path is a deque pop; a miss falls back to one
+        fixed-shape direct draw outside every lock."""
+        kick = False
+        v = None
         with self._mu:
-            q = self._cache.get(prev_call_id)
-            if not q:
-                self._refill_all()
-                q = self._cache[prev_call_id]
-            return q.popleft()
+            q = self._rings.get(prev_call_id)
+            if q:
+                v = q.popleft()
+                self._inv_total -= 1
+                self._drained[prev_call_id + 1] += 1
+                self.stat_served += 1
+                if len(q) * 4 < self._targets[prev_call_id + 1]:
+                    self._starved = True
+                    kick = self._warmed
+        if v is not None:
+            if kick:
+                self._kick()
+            return v
+        return self._underrun_draw(prev_call_id, 1)[0]
+
+    def take(self, prev_call_id: int, n: int) -> list[int]:
+        """Exactly n decisions for one context (the manager's Poll
+        top-up shape): ring first, direct-draw remainder."""
+        out: list[int] = []
+        kick = False
+        with self._mu:
+            q = self._rings.get(prev_call_id)
+            while q and len(out) < n:
+                out.append(q.popleft())
+            got = len(out)
+            self._inv_total -= got
+            self._drained[prev_call_id + 1] += got
+            self.stat_served += got
+            if got and q is not None and \
+                    len(q) * 4 < self._targets[prev_call_id + 1]:
+                self._starved = True
+                kick = self._warmed
+        if kick:
+            self._kick()
+        short = n - len(out)
+        if short > 0:
+            out += self._underrun_draw(prev_call_id, short)
+        return out
+
+    def next_corpus_row(self) -> "int | None":
+        """One pre-drawn signal-weighted corpus row, or None when the
+        ring is dry (caller falls back to its legacy sampler)."""
+        kick = False
+        with self._mu:
+            v = self._crows.popleft() if self._crows else None
+            if len(self._crows) * 4 < self.n_rows:
+                if v is None:
+                    self._direct_dispatches += 1
+                    if self._direct_dispatches >= self.warm_after:
+                        self._warmed = True
+                kick = self._warmed
+        if kick:
+            self._kick()
+        return v
+
+    def take_entropy(self, n: int) -> np.ndarray:
+        """n uint64 words for Rand.refill — pre-drawn slabs first, one
+        bucketed direct draw for any remainder."""
+        chunks: list[np.ndarray] = []
+        got = 0
+        kick = False
+        with self._mu:
+            while self._ent and got < n:
+                a = self._ent.popleft()
+                if len(a) > n - got:
+                    self._ent.appendleft(a[n - got:])
+                    a = a[: n - got]
+                chunks.append(a)
+                got += len(a)
+            self._ent_len -= got
+            if self._ent_len < self.n_entropy // 2:
+                kick = self._warmed
+        if kick:
+            self._kick()
+        if got < n:
+            nb = pow2_bucket(n - got, 1024, 1 << 16)
+            w = self.engine.random_words(nb)
+            chunks.append(w[: n - got])
+            self._note_direct()
+        if len(chunks) == 1:
+            return chunks[0]
+        if not chunks:
+            return np.zeros((0,), np.uint64)
+        return np.concatenate(chunks)
+
+    def _underrun_draw(self, prev: int, want: int) -> list[int]:
+        """Ring miss: one fixed-shape sampling dispatch OUTSIDE every
+        lock (blocking a choose() caller on the prefetcher could
+        deadlock an invalidation storm; a direct draw cannot)."""
+        nb = pow2_bucket(max(want, self.UNDERRUN_BATCH),
+                         self.UNDERRUN_BATCH, 1024)
+        with self._mu:
+            epoch = self._epoch
+        draws = self.engine.sample_next_calls(
+            np.full((nb,), prev, np.int32))
+        if self.tstats is not None:
+            self.tstats.inc("ring_underrun")
+        with self._mu:
+            self.stat_underruns += 1
+            self.stat_served += want
+            self._drained[prev + 1] += want
+            if epoch == self._epoch:
+                # bank the leftover draws — they were paid for; skip
+                # when an invalidate() raced the dispatch (banking
+                # would leave stale draws in the ring after it returned)
+                q = self._rings.setdefault(prev, deque())
+                leftovers = draws[want:]
+                q.extend(int(x) for x in leftovers)
+                self._inv_total += len(leftovers)
+        self._note_direct()
+        return [int(x) for x in draws[:want]]
+
+    def _note_direct(self) -> None:
+        kick = False
+        with self._mu:
+            self._direct_dispatches += 1
+            if self._direct_dispatches >= self.warm_after:
+                self._warmed = True
+                kick = True
+        if kick:
+            self._kick()
+
+    # -- invalidation ------------------------------------------------------
 
     def invalidate(self) -> None:
-        """Drop cached draws (call after the priority matrix changes)."""
+        """Call after a priority-matrix or enabled-set update: every
+        cached choice draw is dropped, any in-flight block is marked
+        stale (epoch bump — it is discarded at publish), and the
+        prefetcher is kicked for an EAGER background redraw so the next
+        choose() finds a warm ring instead of paying the cold-refill
+        latency.  Corpus-row and entropy rings are unaffected (their
+        distributions do not depend on the priority matrix)."""
         with self._mu:
-            self._cache.clear()
+            self._epoch += 1
+            for q in self._rings.values():
+                q.clear()
+            self._inv_total = 0
+            warmed = self._warmed
+        if warmed:
+            self._kick()
+
+    def stop(self) -> None:
+        with self._cv:
+            self._stop = True
+            self._cv.notify_all()
+        if self._thread is not None:
+            self._thread.join(timeout=10.0)
+
+    # -- prefetcher --------------------------------------------------------
+
+    def _kick(self) -> None:
+        with self._cv:
+            self._kicked = True
+            self._cv.notify()
+
+    def _demand(self) -> bool:
+        with self._mu:
+            if self._starved:
+                self._starved = False
+                return True
+            total_target = int(self._targets.sum())
+            if self._inv_total < total_target // 2:
+                return True
+            if len(self._crows) < self.n_rows // 2:
+                return True
+            if self._ent_len < self.n_entropy // 2:
+                return True
+        return False
+
+    def _loop(self) -> None:
+        while True:
+            with self._cv:
+                while not self._kicked and not self._stop:
+                    self._cv.wait(timeout=0.5)
+                if self._stop:
+                    return
+                self._kicked = False
+            try:
+                self._cycle()
+            except Exception as e:  # a dead prefetcher must be visible
+                log.logf(0, "decision-stream prefetch error: %r", e)
+                time.sleep(0.05)
+
+    def _cycle(self) -> None:
+        """Double-buffered refill: dispatch block N+1, THEN resolve and
+        publish block N — the host transfer of one block overlaps the
+        device compute of the next."""
+        while not self._stop and self._demand():
+            self._maybe_adapt()
+            with self._mu:
+                epoch = self._epoch
+                hot_host, hot_dev = self._hot_host, self._hot_dev
+            blk = self.engine.decision_block(
+                hot_dev, self.per_row, self.n_rows, self.n_entropy)
+            prev, self._inflight = self._inflight, (
+                epoch, time.monotonic(), hot_host, blk)
+            self._publish(prev)
+        prev, self._inflight = self._inflight, None
+        self._publish(prev)
+
+    def _publish(self, inflight) -> None:
+        if inflight is None:
+            return
+        epoch, t0, hot_host, blk = inflight
+        # the host syncs — outside every lock
+        base = np.asarray(blk.base)
+        hot = np.asarray(blk.hot)
+        crows = np.asarray(blk.corpus_rows)
+        ent = np.asarray(blk.entropy)
+        words = (ent[0].astype(np.uint64) << np.uint64(32)) \
+            | ent[1].astype(np.uint64)
+        if self.tstats is not None:
+            self.tstats.observe("block_consume_latency",
+                                time.monotonic() - t0)
+        with self._mu:
+            if epoch != self._epoch:
+                self.stat_discarded += 1
+                return
+            self.stat_blocks += 1
+            for row in range(-1, self._R - 1):
+                q = self._rings.setdefault(row, deque())
+                need = self.ring_mult * int(self._targets[row + 1]) - len(q)
+                if need > 0:
+                    add = base[row + 1, :need].tolist()
+                    q.extend(add)
+                    self._inv_total += len(add)
+            for p, v in zip(hot_host.tolist(), hot.tolist()):
+                q = self._rings.setdefault(p, deque())
+                if len(q) < self.ring_mult * int(self._targets[p + 1]):
+                    q.append(v)
+                    self._inv_total += 1
+            if len(self._crows) < 2 * self.n_rows:
+                self._crows.extend(crows.tolist())
+            if self._ent_len < 2 * self.n_entropy:
+                self._ent.append(words)
+                self._ent_len += len(words)
+
+    def _maybe_adapt(self) -> None:
+        """Re-split the hot-slot budget by observed drain rates so hot
+        rows stop starving: the prev composition (operand CONTENTS, not
+        shape) is re-uploaded only when the allocation actually shifts —
+        the megakernel never recompiles for an adaptation step."""
+        with self._mu:
+            if self.stat_blocks - self._last_adapt < self.adapt_every:
+                return
+            self._last_adapt = self.stat_blocks
+            drained = self._drained.copy()
+            self._drained[:] = 0
+        total = int(drained.sum())
+        if total <= 0:
+            return
+        share = np.floor(drained * (self.hot_slots / total)).astype(np.int64)
+        reps = np.repeat(np.arange(-1, self._R - 1, dtype=np.int32), share)
+        comp = np.full((self.hot_slots,), -1, np.int32)
+        comp[: len(reps)] = reps[: self.hot_slots]
+        comp.sort()
+        with self._mu:
+            unchanged = np.array_equal(comp, self._hot_host)
+        if unchanged:
+            return
+        dev = self.engine.put_replicated(comp)
+        cnt = np.bincount(comp.astype(np.int64) + 1, minlength=self._R)
+        with self._mu:
+            self._hot_host = comp
+            self._hot_dev = dev
+            self._targets = self.per_row + cnt
+
+    # -- introspection (tests/bench) --------------------------------------
+
+    def refill_once(self) -> None:
+        """Synchronous dispatch+publish of one block (tests, warm-up,
+        and the bench smoke path); production uses the prefetcher."""
+        self._maybe_adapt()
+        with self._mu:
+            epoch = self._epoch
+            hot_host, hot_dev = self._hot_host, self._hot_dev
+        blk = self.engine.decision_block(
+            hot_dev, self.per_row, self.n_rows, self.n_entropy)
+        self._publish((epoch, time.monotonic(), hot_host, blk))
+
+    def inventory(self) -> int:
+        with self._mu:
+            return self._inv_total
+
+
+class DeviceChoiceTable(DecisionStream):
+    """Back-compat facade: the per-decision choose(rand, prev) interface
+    the program generator consumes (ref prog/prio.go:230), now backed by
+    the decision-stream prefetcher instead of a blocking refill-all."""

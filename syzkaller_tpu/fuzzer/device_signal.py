@@ -168,6 +168,16 @@ class DeviceSignal:
                          "cover still merges, minimize rows dropped",
                          self.engine.cap)
 
+    def row_to_corpus(self, row: int) -> "int | None":
+        """Translate ONE device corpus row (e.g. a decision-stream
+        pre-drawn pick) to the caller's corpus index; None when the row
+        was never recorded or carries no owner."""
+        with self._row_mu:
+            r2c = self._row2corpus
+            if 0 <= row < len(r2c) and r2c[row] >= 0:
+                return r2c[row]
+        return None
+
     def sample_corpus_indices(self, n: int) -> np.ndarray:
         """Signal-weighted corpus picks, translated from device rows to
         the caller's corpus indices via the row map (rows whose owner

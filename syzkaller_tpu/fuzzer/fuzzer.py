@@ -171,12 +171,15 @@ class Fuzzer:
         if prios is None:
             prios = P.calculate_priorities(self.table)
         if self.signal is not None:
-            # Batched categorical draws on device replace the per-call
-            # prefix-sum binary search (ref prog/prio.go:230-249).
+            # The decision-stream plane (ref prog/prio.go:230-249, fused):
+            # one megakernel feeds choice draws, corpus-row picks AND
+            # Rand entropy through a double-buffered async prefetcher —
+            # the per-path sampling dispatches are retired.
             from syzkaller_tpu.fuzzer.device_ct import DeviceChoiceTable
             self.signal.engine.set_priorities(prios)
             self.signal.engine.set_enabled(self.enabled_ids)
-            self.ct = DeviceChoiceTable(self.signal.engine)
+            self.ct = DeviceChoiceTable(self.signal.engine,
+                                        telemetry=self.signal.tstats)
         else:
             self.ct = P.ChoiceTable(prios, set(self.enabled_ids),
                                     ncalls=self.table.count)
@@ -393,14 +396,17 @@ class Fuzzer:
 
     def _proc_loop(self, pid: int) -> None:
         rand = P.Rand(np.random.default_rng(self.seed * 4096 + pid))
+        if self.signal is not None:
+            # device PRNG feeds gen/mutation draws through the decision
+            # stream's pre-drawn entropy slabs: ~8k decisions per pull,
+            # refilled by the prefetcher's fused megakernel dispatch
+            # (SURVEY §7 batching economics) — the pool auto-refills
+            # mid-draw, so no per-iteration exhausted() polling
+            rand.attach_source(self.ct.take_entropy, 1 << 13)
         env = ipc.Env(flags=self.flags, pid=pid)
         gate = self.gate
         try:
             while not self._stop:
-                if self.signal is not None and rand.exhausted():
-                    # device PRNG feeds gen/mutation draws: one jit call
-                    # per ~8k decisions (SURVEY §7 batching economics)
-                    rand.refill(self.signal.engine.random_words(1 << 13))
                 item = None
                 candidate = None
                 with self._mu:
@@ -439,14 +445,21 @@ class Fuzzer:
             env.close()
 
     def _pick_corpus_row(self, ncorpus: int, rand: P.Rand) -> int:
-        """Corpus pick for mutation: device-drawn signal-weighted rows
-        (consumed from a cached batch, one jit call per ~256 picks) with
-        a uniform host fallback.  The refill draw is a device round
-        trip, so it runs OUTSIDE self._mu — holding the proc-shared
-        mutex across it would stall every other proc thread for the
-        tunnel latency (syz-vet lock pass); a concurrent double-refill
-        just buffers extra draws."""
+        """Corpus pick for mutation: the decision stream's pre-drawn
+        signal-weighted rows (a deque pop, zero dispatches) with the
+        legacy cached batched sampler behind it and a uniform host
+        fallback at the bottom.  The legacy refill draw is a device
+        round trip, so it runs OUTSIDE self._mu — holding the
+        proc-shared mutex across it would stall every other proc thread
+        for the tunnel latency (syz-vet lock pass); a concurrent
+        double-refill just buffers extra draws."""
         if self.signal is not None:
+            dev_row = self.ct.next_corpus_row() \
+                if hasattr(self.ct, "next_corpus_row") else None
+            if dev_row is not None:
+                idx = self.signal.row_to_corpus(int(dev_row))
+                if idx is not None and idx < ncorpus:
+                    return idx
             with self._mu:
                 if self._corpus_rows:
                     row = self._corpus_rows.popleft()
@@ -636,6 +649,8 @@ class Fuzzer:
             for t in threads:
                 t.join(timeout=5.0)
             self.flush_signal(force=True)
+            if self.ct is not None and hasattr(self.ct, "stop"):
+                self.ct.stop()          # decision-stream prefetcher
 
     def stop(self) -> None:
         self._stop = True

@@ -139,6 +139,16 @@ class Manager:
         self._repro_active: set[str] = set()
         self._repro_block = 0          # unique index block per repro job
 
+        # decision-stream plane: Poll choice top-ups drain pre-drawn
+        # megakernel blocks via the async prefetcher instead of issuing
+        # their own sampling dispatch (the coalescer's admission-fused
+        # ring stays primary while admissions flow); warm_after keeps
+        # one-shot consumers on the cheap direct path
+        from syzkaller_tpu.fuzzer.device_ct import DecisionStream
+        self.dstream = DecisionStream(self.engine, per_row=64,
+                                      telemetry=self.device_stats,
+                                      warm_after=3)
+
         # batched admission plane: concurrent NewInput RPCs coalesce
         # into fused device dispatches instead of serializing on one
         # round-trip per input under _admit_mu (round-2 verdict weak #5)
@@ -310,13 +320,11 @@ class Manager:
         short = CHOICES_PER_POLL - len(choices)
         if short > 0:
             t0 = time.monotonic()
-            # fixed-shape top-up draw: `short` varies with the ring's
-            # fill level, and every distinct batch size would compile a
-            # fresh sampling kernel (syz-vet retrace pass) — draw the
-            # full batch and slice
-            draws = self.engine.sample_next_calls(
-                np.full((CHOICES_PER_POLL,), -1, np.int32))
-            choices += [int(x) for x in draws[:short]]
+            # top-up from the decision stream's pre-drawn blocks (its
+            # underrun path is one fixed-shape direct draw, so the
+            # retired per-poll sampling dispatch never comes back as a
+            # compile treadmill — syz-vet retrace pass)
+            choices += self.dstream.take(-1, short)
             if self.device_stats is not None:
                 self.device_stats.observe("choice_draw_latency",
                                           time.monotonic() - t0)
@@ -438,6 +446,10 @@ class Manager:
             except Exception:
                 continue
         self.engine.set_priorities(self.static_prios, call_mat)
+        # drop pre-drawn decisions conditioned on the old matrix; the
+        # stream schedules its redraw eagerly off-thread, so the next
+        # Poll top-up finds a warm ring instead of a cold refill
+        self.dstream.invalidate()
 
     # -- hub federation (ref manager.go:658-736) ---------------------------
 
@@ -729,6 +741,7 @@ class Manager:
         self._stop = True
         if self.coalescer is not None:
             self.coalescer.stop()
+        self.dstream.stop()
         if self.cfg.telemetry:
             self.persist_telemetry()     # final post-mortem snapshot
         with self._mu:

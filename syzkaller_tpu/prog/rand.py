@@ -50,17 +50,40 @@ class Rand:
         self._g = seed if isinstance(seed, np.random.Generator) else np.random.default_rng(seed)
         self._pool: np.ndarray = np.empty(0, dtype=np.uint64)
         self._pos = 0
+        self._source = None
+        self._source_batch = 8192
 
     def refill(self, words: np.ndarray) -> None:
-        """Push a batch of device-generated uint64 randomness."""
-        self._pool = np.asarray(words, dtype=np.uint64)
+        """Push a batch of device-generated uint64 randomness.
+        Unconsumed words from the previous batch are kept — they cost a
+        device draw, and discarding them would skew the refill economy
+        toward the host fallback."""
+        words = np.asarray(words, dtype=np.uint64)
+        if self._pos < len(self._pool):
+            words = np.concatenate([self._pool[self._pos:], words])
+        self._pool = words
         self._pos = 0
+
+    def attach_source(self, fn, batch: int = 8192) -> None:
+        """Attach a pull-based entropy source (the decision stream's
+        `take_entropy`): when the pool drains mid-draw, the next slab is
+        pulled automatically — callers no longer poll exhausted() at
+        every refill site.  A failing source detaches itself so a dead
+        device degrades to the host generator instead of raising per
+        draw."""
+        self._source = fn
+        self._source_batch = batch
 
     def exhausted(self) -> bool:
         """True when the device pool has drained (time to refill)."""
         return self._pos >= len(self._pool)
 
     def rand64(self) -> int:
+        if self._pos >= len(self._pool) and self._source is not None:
+            try:
+                self.refill(self._source(self._source_batch))
+            except Exception:
+                self._source = None
         if self._pos < len(self._pool):
             v = int(self._pool[self._pos])
             self._pos += 1

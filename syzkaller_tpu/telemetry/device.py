@@ -51,12 +51,21 @@ SCALAR_SLOTS = [
     ("admit_inputs", "syz_admission_gate_inputs_total", {}),
     ("admit_admitted", "syz_admission_gate_admitted_total", {}),
     ("admit_draws", "syz_choice_draws_total", {"source": "admission"}),
+    # decision-stream plane: refill/draw counts are bumped INSIDE the
+    # fused megakernel dispatch; underruns are host-observed ring misses
+    # staged through the pending buffer (no extra transfers either way)
+    ("ring_refill", "syz_choice_ring_refill_total", {}),
+    ("ring_draws", "syz_choice_draws_total", {"source": "ring"}),
+    ("ring_underrun", "syz_choice_ring_underrun_total", {}),
 ]
 
 HIST_SLOTS = [
     ("admission_latency", "syz_admission_latency_seconds"),
     ("exec_latency", "syz_exec_latency_seconds"),
     ("choice_draw_latency", "syz_choice_draw_latency_seconds"),
+    # dispatch→consumable latency of a decision block — the cold-block
+    # cost the double-buffered prefetcher hides from consumers
+    ("block_consume_latency", "syz_choice_block_consume_seconds"),
 ]
 
 

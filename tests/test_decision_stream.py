@@ -1,0 +1,331 @@
+"""Decision-stream engine tests: the fused megakernel's draw
+distribution vs the retired per-path dispatches (chi-square, fixed
+seed), compile-count pins across ring-size adaptation, and the async
+prefetcher under a concurrent invalidation storm."""
+
+import math
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from syzkaller_tpu.cover.engine import CoverageEngine
+from syzkaller_tpu.fuzzer.device_ct import DecisionStream, DeviceChoiceTable
+
+NCALLS = 8
+NPCS = 1 << 12
+
+
+def chi2_crit(df: int, z: float = 3.72) -> float:
+    """Upper-tail chi-square critical value (~p=1e-4) via the
+    Wilson–Hilferty cube approximation — generous enough that a
+    fixed-seed test never flakes, tight enough that a wrong
+    distribution (e.g. a disabled call leaking in, or a skewed cdf)
+    fails by orders of magnitude."""
+    a = 2.0 / (9.0 * df)
+    return df * (1.0 - a + z * math.sqrt(a)) ** 3
+
+
+def chi2_stat(obs: np.ndarray, exp: np.ndarray) -> float:
+    m = exp > 0
+    return float((((obs - exp) ** 2)[m] / exp[m]).sum())
+
+
+def chi2_two_sample(a: np.ndarray, b: np.ndarray) -> tuple[float, int]:
+    na, nb = a.sum(), b.sum()
+    k1, k2 = math.sqrt(nb / na), math.sqrt(na / nb)
+    m = (a + b) > 0
+    stat = float((((k1 * a - k2 * b) ** 2)[m] / (a + b)[m]).sum())
+    return stat, int(m.sum()) - 1
+
+
+def make_engine(seed=3):
+    eng = CoverageEngine(npcs=NPCS, ncalls=NCALLS, corpus_cap=64,
+                         seed=seed)
+    prios = (np.arange(NCALLS * NCALLS, dtype=np.float32)
+             .reshape(NCALLS, NCALLS) % 7 + 1.0) / 7.0
+    eng.set_priorities(prios)
+    eng.set_enabled([0, 2, 3, 5, 6])
+    return eng, prios
+
+
+def collect_fused(eng, stream, prev: int, n: int) -> np.ndarray:
+    """Fused-path draws for one prev context: the decision block's base
+    row prev+1, accumulated across blocks."""
+    out = []
+    while len(out) < n:
+        blk = eng.decision_block(stream._hot_dev, stream.per_row,
+                                 stream.n_rows, stream.n_entropy)
+        out.extend(np.asarray(blk.base)[prev + 1].tolist())
+    return np.asarray(out[:n])
+
+
+def test_fused_draws_match_direct_distribution():
+    """The decision megakernel must draw from the SAME categorical
+    distribution as the retired per-path dispatch (sample_next_calls):
+    chi-square vs the exact expected probabilities AND a two-sample
+    test fused-vs-direct, both per-context and no-context rows."""
+    eng, prios = make_engine()
+    stream = DecisionStream(eng, per_row=512, hot_slots=64,
+                            corpus_rows=32, entropy_words=1024,
+                            autostart=False)
+    enabled = np.zeros(NCALLS, bool)
+    enabled[[0, 2, 3, 5, 6]] = True
+    N = 4096
+    for prev in (-1, 2, 5):
+        w = np.where(enabled,
+                     np.ones(NCALLS) if prev < 0 else prios[prev], 0.0)
+        p = w / w.sum()
+        fused = collect_fused(eng, stream, prev, N)
+        direct = eng.sample_next_calls(np.full((N,), prev, np.int32))
+        # no disabled call may ever appear on either path
+        assert set(np.unique(fused)) <= {0, 2, 3, 5, 6}
+        assert set(np.unique(direct)) <= {0, 2, 3, 5, 6}
+        obs_f = np.bincount(fused, minlength=NCALLS)
+        obs_d = np.bincount(direct, minlength=NCALLS)
+        df = int((p > 0).sum()) - 1
+        crit = chi2_crit(df)
+        assert chi2_stat(obs_f, N * p) < crit, (prev, obs_f, N * p)
+        assert chi2_stat(obs_d, N * p) < crit, (prev, obs_d, N * p)
+        stat2, df2 = chi2_two_sample(obs_f, obs_d)
+        assert stat2 < chi2_crit(df2), (prev, obs_f, obs_d)
+
+
+def test_decision_block_corpus_rows_weighted(rng):
+    """Corpus-row picks in the block are signal-weighted like the
+    retired sample_corpus_rows dispatch: the signal-rich row
+    dominates."""
+    eng, _ = make_engine()
+    big = np.arange(0, 400, dtype=np.uint32)
+    small = np.arange(600, 604, dtype=np.uint32)
+    idx = np.zeros((2, 512), np.int32)
+    valid = np.zeros((2, 512), bool)
+    for i, c in enumerate((big, small)):
+        idx[i, : len(c)] = c
+        valid[i, : len(c)] = True
+    eng.merge_corpus(np.zeros(2, np.int32), eng.pack_batch(idx, valid))
+    stream = DecisionStream(eng, per_row=8, hot_slots=64, corpus_rows=512,
+                            entropy_words=1024, autostart=False)
+    blk = eng.decision_block(stream._hot_dev, stream.per_row,
+                             stream.n_rows, stream.n_entropy)
+    rows = np.asarray(blk.corpus_rows)
+    live = rows[rows < eng.corpus_len]
+    assert (live == 0).sum() > (live == 1).sum()
+
+
+def test_entropy_slab_feeds_rand():
+    """take_entropy slabs are exact-size uint64 words, fresh across
+    pulls, and Rand auto-refills from an attached stream source."""
+    from syzkaller_tpu import prog as P
+
+    eng, _ = make_engine()
+    stream = DecisionStream(eng, per_row=8, hot_slots=64, corpus_rows=32,
+                            entropy_words=1024, autostart=False)
+    stream.refill_once()
+    a = stream.take_entropy(700)
+    b = stream.take_entropy(700)
+    assert a.shape == (700,) and a.dtype == np.uint64
+    assert not np.array_equal(a, b)
+    r = P.Rand(np.random.default_rng(0))
+    r.attach_source(stream.take_entropy, 256)
+    first = r.rand64()                  # pool empty → auto-pull
+    assert r._pos == 1 and len(r._pool) == 256
+    assert isinstance(first, int)
+    # a dying source detaches instead of raising per draw
+    r2 = P.Rand(np.random.default_rng(0))
+
+    def dead(n):
+        raise RuntimeError("backend gone")
+
+    r2.attach_source(dead)
+    assert isinstance(r2.rand64(), int)
+    assert r2._source is None
+
+
+def test_rand_refill_keeps_unconsumed_words():
+    from syzkaller_tpu import prog as P
+
+    r = P.Rand(np.random.default_rng(0))
+    r.refill(np.arange(4, dtype=np.uint64))
+    assert r.rand64() == 0
+    r.refill(np.arange(10, 14, dtype=np.uint64))
+    # the 3 unconsumed words drain before the new slab
+    assert [r.rand64() for _ in range(4)] == [1, 2, 3, 10]
+
+
+def test_megakernel_compiles_once_across_adaptation():
+    """CompileCounter pin: ring-size adaptation changes the hot-prev
+    OPERAND (contents) only — shapes stay in the pow2-bucketed closed
+    set, so a warm megakernel never recompiles however the drain rates
+    shift."""
+    from syzkaller_tpu.vet.runtime import CompileCounter
+
+    eng, _ = make_engine()
+    stream = DecisionStream(eng, per_row=32, hot_slots=64, corpus_rows=32,
+                            entropy_words=1024, adapt_every=1,
+                            autostart=False)
+    stream.refill_once()                 # warm: compiles once
+    with CompileCounter() as cc:
+        for hot_row in (2, 5, 0):        # three different drain skews
+            with stream._mu:
+                stream._drained[:] = 0
+                stream._drained[hot_row + 1] = 1000
+                stream.stat_blocks += stream.adapt_every
+            stream.refill_once()         # adapts composition + dispatches
+            # adaptation actually shifted the hot allocation to the row
+            assert (stream._hot_host == hot_row).sum() > 0
+    assert cc.count == 0, cc.events
+
+
+def test_adaptive_targets_follow_drain():
+    """Hot rows earn ring capacity: after a skewed drain, the adapted
+    per-row target for the hot row exceeds the cold rows'."""
+    eng, _ = make_engine()
+    stream = DecisionStream(eng, per_row=32, hot_slots=256, corpus_rows=32,
+                            entropy_words=1024, adapt_every=1,
+                            autostart=False)
+    stream.refill_once()
+    with stream._mu:
+        stream._drained[:] = 1
+        stream._drained[3 + 1] = 5000
+        stream.stat_blocks += stream.adapt_every
+    stream.refill_once()
+    assert stream._targets[3 + 1] > stream._targets[1 + 1]
+
+
+def test_invalidate_discards_inflight_and_redraws_eagerly():
+    """After invalidate() the prefetcher repopulates the rings in the
+    BACKGROUND — no consumer pays the cold-refill latency — and blocks
+    dispatched against the old priority matrix are discarded."""
+    eng, _ = make_engine()
+    stream = DecisionStream(eng, per_row=32, hot_slots=64, corpus_rows=32,
+                            entropy_words=1024, warm_after=0)
+    try:
+        stream.choose(prev_call_id=-1)   # warms + kicks the prefetcher
+        deadline = time.monotonic() + 30.0
+        while stream.stat_blocks == 0 and time.monotonic() < deadline:
+            time.sleep(0.01)
+        assert stream.stat_blocks > 0
+        stream.invalidate()
+        assert stream.inventory() == 0 or stream.stat_blocks > 0
+        # eager background redraw: inventory recovers with NO consumer
+        deadline = time.monotonic() + 30.0
+        while stream.inventory() == 0 and time.monotonic() < deadline:
+            time.sleep(0.01)
+        assert stream.inventory() > 0
+    finally:
+        stream.stop()
+
+
+def test_concurrent_choose_under_invalidation_storm():
+    """N threads hammer choose()/next_corpus_row() through an
+    enabled-set flip storm: no deadlock, no errors, and — the stale-row
+    contract — every draw observed after the final invalidate() returns
+    comes from the NEW enabled set."""
+    eng, _ = make_engine()
+    stream = DecisionStream(eng, per_row=32, hot_slots=64, corpus_rows=64,
+                            entropy_words=1024, warm_after=0)
+    stop = threading.Event()
+    after = threading.Event()
+    errs: list = []
+    post: list[list[int]] = [[] for _ in range(4)]
+
+    def worker(k):
+        prevs = [-1, 0, 2, 5]
+        i = 0
+        try:
+            while not stop.is_set():
+                # sample the phase BEFORE drawing: the stale-row
+                # contract covers calls that START after invalidate()
+                # returned, not draws already in flight across it
+                rec = after.is_set()
+                v = stream.choose(prev_call_id=prevs[(i + k) % 4])
+                if rec:
+                    post[k].append(v)
+                if i % 7 == 0:
+                    stream.next_corpus_row()
+                i += 1
+        except Exception as e:  # pragma: no cover
+            errs.append(e)
+
+    ts = [threading.Thread(target=worker, args=(k,)) for k in range(4)]
+    for t in ts:
+        t.start()
+    try:
+        sets = ([0, 2, 3, 5, 6], [1, 4, 7])
+        for i in range(10):
+            eng.set_enabled(sets[i % 2])
+            stream.invalidate()
+            time.sleep(0.005)
+        eng.set_enabled([2, 4])
+        stream.invalidate()
+        after.set()
+        time.sleep(0.4)
+    finally:
+        stop.set()
+        for t in ts:
+            t.join(timeout=30.0)
+    assert not any(t.is_alive() for t in ts), "choose() deadlocked"
+    assert not errs, errs
+    drawn_after = [v for lst in post for v in lst]
+    assert drawn_after, "no draws observed after the final invalidate"
+    assert set(drawn_after) <= {2, 4}, sorted(set(drawn_after))
+    stream.stop()
+
+
+def test_stream_telemetry_counters():
+    """Refill counts are bumped INSIDE the fused dispatch (device stat
+    vector), underruns ride the pending buffer, and the block-consume
+    histogram fills."""
+    from syzkaller_tpu.telemetry import DeviceStats
+
+    ds = DeviceStats()
+    eng = CoverageEngine(npcs=NPCS, ncalls=NCALLS, corpus_cap=16,
+                         telemetry=ds)
+    eng.set_enabled(range(NCALLS))
+    stream = DecisionStream(eng, per_row=32, hot_slots=64, corpus_rows=32,
+                            entropy_words=1024, autostart=False,
+                            telemetry=ds)
+    stream.refill_once()
+    stream.refill_once()
+    vals = ds.values()
+    assert vals[ds.slot("ring_refill")] == 2
+    assert vals[ds.slot("ring_draws")] == 2 * stream.draws_per_block
+    stream.invalidate()
+    stream.choose(prev_call_id=1)        # ring dry → underrun
+    # pending underrun increments fold in via the next dispatch
+    stream.refill_once()
+    vals = ds.values()
+    assert vals[ds.slot("ring_underrun")] == 1
+    base = ds.hist_base("block_consume_latency")
+    from syzkaller_tpu.telemetry.device import NBUCKETS
+    assert vals[base: base + NBUCKETS].sum() == 3
+
+
+def test_device_choice_table_facade():
+    """The back-compat interface: construct from an engine, choose()
+    with a Rand arg, invalidate; draws respect enabled."""
+    eng, _ = make_engine()
+    ct = DeviceChoiceTable(eng, autostart=False)
+    try:
+        ct.refill_once()
+        for _ in range(64):
+            assert ct.choose(None, 2) in {0, 2, 3, 5, 6}
+        ct.invalidate()
+        assert ct.inventory() == 0
+        assert ct.choose(None, -1) in {0, 2, 3, 5, 6}
+    finally:
+        ct.stop()
+
+
+def test_take_exact_count_and_validity():
+    """take() returns exactly n draws from ring + underrun remainder —
+    the manager Poll top-up contract."""
+    eng, _ = make_engine()
+    stream = DecisionStream(eng, per_row=8, hot_slots=64, corpus_rows=32,
+                            entropy_words=1024, autostart=False)
+    for n in (3, 64, 100):
+        out = stream.take(-1, n)
+        assert len(out) == n
+        assert set(out) <= {0, 2, 3, 5, 6}
