@@ -458,7 +458,7 @@ def bench_admission(n_inputs=1536, nthreads=48, admit_batch=64, npcs=NPCS):
     serial per-input rpc_new_input path: N handler threads fire
     distinct NewInputs (disjoint cover ranges, so the admitted set is
     order-independent) at a live manager, once with admit_batch<=1
-    (serial: _admit_mu held across one device round-trip per input) and
+    (serial: one device round-trip per input) and
     once with the coalescer (fused batched dispatches).  Handlers are
     invoked directly — the RPC socket layer is byte-identical for both
     paths and exercised by the concurrent-admission test.
@@ -550,6 +550,113 @@ def bench_admission(n_inputs=1536, nthreads=48, admit_batch=64, npcs=NPCS):
         "telemetry_overhead_pct": round(
             100.0 * (1.0 - coal_rate / off_rate), 1),
     }, snap
+
+
+def bench_triage(rng, n_reports=10_000, smoke=False):
+    """Crash-intelligence dedup at production volume: n synthetic
+    parsed reports (oops-corpus-shaped generator, ~40 distinct crash
+    templates under title/frame noise) clustered through the signature
+    kernel.  The similarity matmul + threshold-union-find run as ONE
+    fused dispatch per batch; warm batches are CompileCounter-pinned at
+    zero recompiles.  Reported end-to-end (featurize + dispatch + label
+    fetch) and kernel-only."""
+    from syzkaller_tpu.telemetry import DeviceStats
+    from syzkaller_tpu.triage import CrashIndex, SignatureKernel
+    from syzkaller_tpu.triage import synth
+    from syzkaller_tpu.vet.runtime import CompileCounter
+
+    n = 256 if smoke else n_reports
+    reports = synth.reports(rng, n)
+    ds = DeviceStats()
+    kern = SignatureKernel(telemetry=ds)
+    feats = kern.featurize(reports)
+    kern.cluster(feats)                     # compile + warm the bucket
+    with CompileCounter() as cc:
+        t0 = time.perf_counter()
+        labels = kern.cluster(feats)
+        kern_dt = time.perf_counter() - t0
+    nclusters = len(set(int(x) for x in labels))
+    # end-to-end through the incremental index (the manager's
+    # save_crash path at batch width): featurize + one fused dispatch
+    idx = CrashIndex(kernel=kern)
+    t0 = time.perf_counter()
+    idx.assign(reports)
+    e2e_dt = time.perf_counter() - t0
+    return {
+        "triage_dedup_reports_per_sec": round(n / e2e_dt, 1),
+        "triage_kernel_reports_per_sec": round(n / kern_dt, 1),
+        "triage_batch_reports": n,
+        "triage_clusters": nclusters,
+        "triage_warm_recompiles": cc.count,
+        "triage_telemetry": {
+            k: v for k, v in ds.snapshot().items() if "triage" in k},
+    }
+
+
+def bench_repro_rounds(smoke=False):
+    """Batched-bisection repro: N crashes against one W-worker oracle
+    pool via the triage scheduler, vs N serial `repro.run` bisections.
+    The headline is rounds per crash — wall rounds a VM pool must turn
+    — which the scheduler holds near the deepest single machine
+    instead of the serial sum."""
+    import math
+
+    from syzkaller_tpu import repro as repro_pkg
+    from syzkaller_tpu.sys.table import load_table
+    from syzkaller_tpu.triage import ReproScheduler
+
+    table = load_table(files=["probe.txt"])
+    N = 3 if smoke else 12
+    W = 4 if smoke else 8
+    markers = [b"0xdead%04x" % i for i in range(N)]
+
+    def make_log(marker):
+        return (b"executing program 0:\n"
+                b"syz_probe$ints(0x1, 0x2, 0x3, 0x4, 0x5)\n"
+                b"executing program 1:\n"
+                b"syz_probe$ints(" + marker + b", 0x2, 0x3, 0x4, 0x5)\n"
+                b"syz_probe()\n"
+                b"[ 2.0] BUG: KASAN: use-after-free in foo+0x1/0x2\n")
+
+    def crashes(data, opts, duration):
+        return any(m in data for m in markers)
+
+    class PoolOracle(repro_pkg.Oracle):
+        def __init__(self):
+            super().__init__(crashes, workers=W)
+
+    done = []
+    sched = ReproScheduler(PoolOracle(), table, with_c_repro=False,
+                           on_done=lambda t, d, r, j: done.append(r))
+    t0 = time.perf_counter()
+    for i, m in enumerate(markers):
+        sched.submit(make_log(m), f"bench-crash-{i}", "")
+    sched.join(timeout=120)
+    batched_dt = time.perf_counter() - t0
+    rounds, tests = sched.stat_rounds, sched.stat_tests
+    sched.stop()
+    assert len(done) == N and all(
+        r is not None and r.prog is not None for r in done), \
+        "repro scheduler failed to reproduce the bench crashes"
+
+    serial_rounds = 0
+    for m in markers:
+        calls = [0]
+
+        def counting(data, opts, duration, calls=calls):
+            calls[0] += 1
+            return crashes(data, opts, duration)
+
+        repro_pkg.run(make_log(m), table, counting, with_c_repro=False,
+                      quick=0.001, thorough=0.002)
+        serial_rounds += calls[0]
+    return {
+        "repro_rounds_per_crash": round(rounds / N, 2),
+        "repro_rounds_per_crash_serial": round(serial_rounds / N, 2),
+        "repro_round_speedup": round(serial_rounds / max(rounds, 1), 2),
+        "repro_round_bound": math.ceil(tests / W) + serial_rounds // N,
+        "repro_batched_wall_sec": round(batched_dt, 3),
+    }
 
 
 def _stage(name):
@@ -652,6 +759,11 @@ def main(argv=None):
     _stage("decision stream")
     extras.update(bench_decision_stream(
         seconds=0.5 if args.smoke else 2.0, smoke=args.smoke))
+    _stage("triage dedup")
+    extras.update(bench_triage(np.random.default_rng(17),
+                               smoke=args.smoke))
+    _stage("repro scheduler")
+    extras.update(bench_repro_rounds(smoke=args.smoke))
     # static-analysis gate trajectory: the BENCH_*.json series records
     # the vet finding counts alongside throughput, so a PR that buys
     # speed by parking P0s in the baseline shows up in the history

@@ -168,7 +168,10 @@ class AdmissionCoalescer:
             mgr._c_coal_inputs.inc(len(batch))
         self.stat_batches += 1
         mgr._c_coal_batches.inc()
-        with mgr._admit_mu:
+        # shared side of the admission gate: excludes corpus
+        # maintenance (row compaction) only — the fused dispatch itself
+        # is serialized inside the engine, no mutex held across it
+        with mgr._admit_gate.admitting():
             # host-side dedup FIRST (same early-out as the serial path):
             # already-in-corpus or repeated-in-batch sigs resolve to the
             # empty reply without touching the device

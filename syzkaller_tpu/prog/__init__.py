@@ -18,7 +18,9 @@ from syzkaller_tpu.prog.encoding import (  # noqa: F401
 )
 from syzkaller_tpu.prog.encodingexec import serialize_for_exec  # noqa: F401
 from syzkaller_tpu.prog.generation import generate  # noqa: F401
-from syzkaller_tpu.prog.mutation import minimize, mutate, trim_after  # noqa: F401
+from syzkaller_tpu.prog.mutation import (  # noqa: F401
+    minimize, minimize_steps, mutate, trim_after,
+)
 from syzkaller_tpu.prog.parse import parse_log  # noqa: F401
 from syzkaller_tpu.prog.prio import ChoiceTable, calculate_priorities  # noqa: F401
 from syzkaller_tpu.prog.rand import Gen, Rand  # noqa: F401

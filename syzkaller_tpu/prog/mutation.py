@@ -254,7 +254,27 @@ def minimize(p: M.Prog, call_index: int, pred: Pred,
     candidate (dozens of kernel round-trips — ref fuzzer.go:421-435); the
     tried-paths memo keeps the number of attempts linear-ish.
     call_index == -1 (crash mode, ref repro.go:193-200): no call is
-    pinned — any call may go as long as the predicate holds."""
+    pinned — any call may go as long as the predicate holds.
+
+    Callback driver over `minimize_steps` — schedulers that batch many
+    minimizations across a shared execution pool drive the generator
+    directly."""
+    gen = minimize_steps(p, call_index, crash_mode)
+    try:
+        q, ci = next(gen)
+        while True:
+            q, ci = gen.send(pred(q, ci))
+    except StopIteration as s:
+        return s.value
+
+
+def minimize_steps(p: M.Prog, call_index: int, crash_mode: bool = False):
+    """Generator form of `minimize`: yields candidate (prog,
+    call_index) pairs, receives via send() whether the predicate held,
+    and returns the final (prog, call_index) as StopIteration.value.
+    The inversion lets a repro scheduler interleave MANY bisections'
+    predicate executions into shared VM-pool rounds instead of blocking
+    one thread per minimization."""
     p = M.clone_prog(p)
     # 1. Call removal, from the end (later calls can't be depended on).
     i = len(p.calls) - 1
@@ -263,7 +283,7 @@ def minimize(p: M.Prog, call_index: int, pred: Pred,
             q = M.clone_prog(p)
             M.remove_call(q, i)
             ni = call_index - 1 if 0 <= i < call_index else call_index
-            if pred(q, ni):
+            if (yield q, ni):
                 p, call_index = q, ni
         i -= 1
     # 2. Per-arg simplification on every remaining call.  The tried memo
@@ -292,7 +312,7 @@ def minimize(p: M.Prog, call_index: int, pred: Pred,
                 analysis.assign_sizes_call(q.calls[ci])
                 if encoding.serialize(q) == content:
                     continue  # no-op simplification: don't burn a pred exec
-                if pred(q, call_index):
+                if (yield q, call_index):
                     p = q
                     progress = True
                     tried.clear()

@@ -1,7 +1,7 @@
 """Crash intelligence: oops parsing + symbolization."""
 
 from syzkaller_tpu.report.report import (  # noqa: F401
-    OOPSES, Report, contains_crash, parse,
+    OOPSES, Report, contains_crash, extract_frames, parse,
 )
 from syzkaller_tpu.report.symbolizer import (  # noqa: F401
     Symbolizer, parse_nm, symbolize_report,

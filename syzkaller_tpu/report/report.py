@@ -146,6 +146,63 @@ class Report:
     start: int        # byte offset of the oops in the input
     end: int
     corrupted: bool = False
+    # stack-PC sequence signature for the triage plane: call-trace
+    # function names in report order (boilerplate frames filtered),
+    # extracted once at parse time
+    frames: "list[str]" = field(default_factory=list)
+
+
+# -- signature feature extraction (triage/signature.py input) --------------
+#
+# Frame sources, oldest console format first: pre-4.11 bracketed-PC
+# trace lines, RIP register lines (both double-PC and modern styles),
+# arm's "PC/LR is at", and modern bare `func+0xoff/0xsize` trace lines.
+# `? frame` entries are speculative unwinds (QUESTIONABLE_RE) and never
+# match: the patterns require the function name directly after the
+# anchor.
+_FRAME_RES = [
+    re.compile(rb"\[\<[0-9a-f]+\>\]\s+([a-zA-Z0-9_.]+)\+0x[0-9a-f]+/"),
+    re.compile(rb"RIP: [0-9]+:([a-zA-Z0-9_.]+)\+0x[0-9a-f]+/"),
+    re.compile(rb"(?:PC|LR) is at ([a-zA-Z0-9_.]+)\+0x[0-9a-f]+/"),
+    re.compile(rb"^\s*([a-zA-Z0-9_.]+)\+0x[0-9a-f]+/0x[0-9a-f]+\s*$"),
+]
+
+# frames present in virtually every report of a sanitizer/oops class:
+# they carry no bug identity and would pull unrelated crashes together
+# in the similarity kernel (the reference's skip-list idiom,
+# report.go's common-frame filtering)
+_BOILERPLATE_FRAMES = frozenset({
+    "dump_stack", "show_stack", "show_regs", "panic", "die", "oops_end",
+    "kasan_report", "kasan_object_err", "kasan_report_invalid_free",
+    "check_memory_region", "print_address_description", "kmsan_report",
+    "kcsan_report", "report_bug", "__warn", "warn_slowpath_fmt",
+    "warn_slowpath_null", "__stack_chk_fail", "kmemleak_alloc",
+})
+
+MAX_FRAMES = 8
+
+
+def extract_frames(text: bytes, max_frames: int = MAX_FRAMES
+                   ) -> "list[str]":
+    """Call-trace function names from an oops region, report order,
+    boilerplate filtered — the stack-PC half of a crash's triage
+    signature (the title is the other half)."""
+    out: list[str] = []
+    for raw in text.split(b"\n"):
+        line = strip_console_prefix(raw)
+        for pat in _FRAME_RES:
+            m = pat.search(line)
+            if m is None:
+                continue
+            name = m.group(1).decode(errors="replace")
+            if name in _BOILERPLATE_FRAMES:
+                break
+            if not out or out[-1] != name:
+                out.append(name)
+            break
+        if len(out) >= max_frames:
+            break
+    return out
 
 
 def contains_crash(output: bytes,
@@ -194,7 +251,8 @@ def parse(output: bytes,
     if not desc:
         desc = first_line.decode(errors="replace")[:120]
     return Report(description=desc, text=region, start=start,
-                  end=min(len(output), start + len(region)))
+                  end=min(len(output), start + len(region)),
+                  frames=extract_frames(region))
 
 
 def _extract_description(oops: Oops, region: bytes) -> str:

@@ -1,4 +1,5 @@
 """Automatic crash reproduction."""
 
 from syzkaller_tpu.repro.repro import (  # noqa: F401
-    Oracle, Result, VmOracle, run, vm_test_fn)
+    Oracle, Result, TestBatch, TestOne, VmOracle, _as_oracle, run,
+    run_steps, vm_test_fn)

@@ -34,6 +34,32 @@ from syzkaller_tpu.vm.monitor import monitor_execution
 TestFn = Callable[[bytes, csource.Options, float], bool]
 
 
+# -- stateful bisection steps (the scheduler's work-unit protocol) ----------
+#
+# `run_steps` is a generator that yields these requests and receives
+# their answers via send(): a TestBatch asks "which (if any) of these
+# candidates reproduces?" (first_crasher semantics — answered with the
+# earliest crashing index or None), a TestOne is a single predicate
+# execution (answered with a bool).  `run` drives one machine against
+# one oracle; triage.scheduler.ReproScheduler drives MANY machines
+# against one shared VM pool, packing their outstanding requests into
+# the same fan-out rounds.
+
+@dataclass
+class TestBatch:
+    items: "list[tuple[bytes, csource.Options]]"
+    duration: float
+    phase: str = "suspects"
+
+
+@dataclass
+class TestOne:
+    data: bytes
+    opts: csource.Options
+    duration: float
+    phase: str = ""
+
+
 class Oracle:
     """Crash-testing backend.  `test` answers one question; `first_crasher`
     answers many, in parallel when the backend has multiple machines
@@ -43,13 +69,23 @@ class Oracle:
     def __init__(self, test: TestFn, workers: int = 1):
         self.test = test
         self.workers = max(1, workers)
+        # indices actually executed by the most recent first_crasher
+        # call, in start order — observability for the early-cancel
+        # contract (tests pin which candidates were spent)
+        self.last_tested: "list[int]" = []
 
     def first_crasher(self, items: "list[tuple[bytes, csource.Options]]",
                       duration: float) -> "int | None":
         """Index of the earliest item that reproduces, or None.  Earlier
-        items are preferred (suspects are ordered most-likely-first)."""
+        items are preferred (suspects are ordered most-likely-first).
+        Early-cancel: the moment the earliest *remaining* candidate is a
+        confirmed crasher (every earlier index resolved without
+        crashing), workers drain the queue instead of testing
+        strictly-later items."""
+        self.last_tested = []
         if self.workers == 1 or len(items) <= 1:
             for i, (data, opts) in enumerate(items):
+                self.last_tested.append(i)
                 if self.test(data, opts, duration):
                     return i
             return None
@@ -59,10 +95,20 @@ class Oracle:
         for i in range(len(items)):
             jobs.put(i)
         crashed: set[int] = set()
+        resolved: set[int] = set()       # tested or errored
+        cancel = threading.Event()
         mu = threading.Lock()
 
+        def finalized() -> bool:
+            """Under mu: the answer can no longer improve — the
+            earliest crasher has no unresolved earlier candidate."""
+            if not crashed:
+                return False
+            m = min(crashed)
+            return all(j in resolved for j in range(m))
+
         def worker(wid: int):
-            while True:
+            while not cancel.is_set():
                 try:
                     i = jobs.get_nowait()
                 except queue_mod.Empty:
@@ -71,17 +117,22 @@ class Oracle:
                     # a confirmed earlier crasher makes later items moot
                     if crashed and i > min(crashed):
                         continue
+                    self.last_tested.append(i)
                 try:
                     hit = self._test_on(wid, items[i][0], items[i][1],
                                         duration)
                 except Exception as e:
                     # a broken machine must not silently kill the worker
-                    # (and with it every suspect still queued)
+                    # (and with it every suspect still queued); the item
+                    # counts as resolved-no-crash so finality can land
                     log.logf(0, "repro worker %d: test failed: %s", wid, e)
-                    continue
-                if hit:
-                    with mu:
+                    hit = False
+                with mu:
+                    resolved.add(i)
+                    if hit:
                         crashed.add(i)
+                    if finalized():
+                        cancel.set()
 
         threads = [threading.Thread(target=worker, args=(w,), daemon=True)
                    for w in range(min(self.workers, len(items)))]
@@ -90,6 +141,37 @@ class Oracle:
         for t in threads:
             t.join()
         return min(crashed) if crashed else None
+
+    def test_many(self, units: "list[tuple[bytes, csource.Options, float]]"
+                  ) -> "list[bool]":
+        """One pool round over mixed work units: unit k runs on worker
+        k (callers cap len(units) at self.workers), every verdict is
+        returned — no early-cancel, the units belong to different
+        consumers (the batched repro scheduler's round primitive).
+        A machine error reads as no-crash, like first_crasher."""
+        if len(units) == 1:
+            data, opts, duration = units[0]
+            try:
+                return [self._test_on(0, data, opts, duration)]
+            except Exception as e:
+                log.logf(0, "repro worker 0: test failed: %s", e)
+                return [False]
+        out = [False] * len(units)
+
+        def worker(k: int):
+            data, opts, duration = units[k]
+            try:
+                out[k] = self._test_on(k, data, opts, duration)
+            except Exception as e:
+                log.logf(0, "repro worker %d: test failed: %s", k, e)
+
+        threads = [threading.Thread(target=worker, args=(k,), daemon=True)
+                   for k in range(len(units))]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        return out
 
     def _test_on(self, wid: int, data: bytes, opts, duration: float) -> bool:
         """Run one test on worker wid's machine (serial default ignores
@@ -190,16 +272,16 @@ def extract_suspects(crash_log: bytes, table: SyscallTable) -> list[M.Prog]:
     return [entries[i].prog for i in order + rest]
 
 
-def run(crash_log: bytes, table: SyscallTable, test_fn: TestFn,
-        with_c_repro: bool = True, c_test_fn=None,
-        quick: float = 10.0, thorough: float = 300.0) -> "Result | None":
-    """c_test_fn(binary_path, duration) -> crashed?: when provided, the C
-    reproducer is actually executed and dropped if it doesn't reproduce
-    (ref repro.go:254-271); otherwise it is only verified to compile."""
+def run_steps(crash_log: bytes, table: SyscallTable,
+              with_c_repro: bool = True, c_test_fn=None,
+              quick: float = 10.0, thorough: float = 300.0):
+    """The bisection state machine, inverted: yields TestBatch/TestOne
+    requests, receives their answers via send(), and returns the final
+    Result (or None) as StopIteration.value.  `run` drives it against
+    one oracle; the triage scheduler advances many of these machines
+    per shared VM-pool round."""
     t0 = time.time()
     res = Result()
-    oracle = _as_oracle(test_fn)
-    test_fn = oracle.test
     suspects = extract_suspects(crash_log, table)
     if not suspects:
         log.logf(0, "repro: no programs in crash log")
@@ -211,7 +293,7 @@ def run(crash_log: bytes, table: SyscallTable, test_fn: TestFn,
     for duration in (quick, thorough):
         items = [(P.serialize(p), opts) for p in suspects[:10]]
         res.attempts += len(items)
-        hit = oracle.first_crasher(items, duration)
+        hit = yield TestBatch(items, duration)
         if hit is not None:
             found = suspects[hit]
             break
@@ -220,12 +302,18 @@ def run(crash_log: bytes, table: SyscallTable, test_fn: TestFn,
         log.logf(0, "repro: no suspect reproduces the crash")
         return None
 
-    # minimize program under the crash predicate (ref :193-200)
-    def pred(q: M.Prog, ci: int) -> bool:
-        res.attempts += 1
-        return test_fn(P.serialize(q), opts, quick)
-
-    found, _ = P.minimize(found, -1, pred, crash_mode=True)
+    # minimize program under the crash predicate (ref :193-200),
+    # one predicate execution per yielded step
+    mingen = P.minimize_steps(found, -1, crash_mode=True)
+    try:
+        q, ci = next(mingen)
+        while True:
+            res.attempts += 1
+            ok = yield TestOne(P.serialize(q), opts, quick,
+                               phase="minimize")
+            q, ci = mingen.send(bool(ok))
+    except StopIteration as s:
+        found, _ = s.value
 
     # simplify options, cheapest first (ref :203-252)
     for simplify in (
@@ -236,7 +324,8 @@ def run(crash_log: bytes, table: SyscallTable, test_fn: TestFn,
     ):
         cand = simplify(opts)
         res.attempts += 1
-        if test_fn(P.serialize(found), cand, quick):
+        if (yield TestOne(P.serialize(found), cand, quick,
+                          phase="simplify")):
             opts = cand
 
     res.prog = found
@@ -265,3 +354,29 @@ def run(crash_log: bytes, table: SyscallTable, test_fn: TestFn,
                     pass
     res.duration = time.time() - t0
     return res
+
+
+def run(crash_log: bytes, table: SyscallTable, test_fn: TestFn,
+        with_c_repro: bool = True, c_test_fn=None,
+        quick: float = 10.0, thorough: float = 300.0) -> "Result | None":
+    """One-crash driver over `run_steps`: TestBatch requests resolve
+    through the oracle's parallel first_crasher, TestOne through one
+    serial predicate execution — exactly the legacy serial-bisection
+    behavior.  c_test_fn(binary_path, duration) -> crashed?: when
+    provided, the C reproducer is actually executed and dropped if it
+    doesn't reproduce (ref repro.go:254-271); otherwise it is only
+    verified to compile."""
+    oracle = _as_oracle(test_fn)
+    gen = run_steps(crash_log, table, with_c_repro=with_c_repro,
+                    c_test_fn=c_test_fn, quick=quick, thorough=thorough)
+    answer = None
+    try:
+        req = next(gen)
+        while True:
+            if isinstance(req, TestBatch):
+                answer = oracle.first_crasher(req.items, req.duration)
+            else:
+                answer = oracle.test(req.data, req.opts, req.duration)
+            req = gen.send(answer)
+    except StopIteration as s:
+        return s.value

@@ -57,6 +57,12 @@ SCALAR_SLOTS = [
     ("ring_refill", "syz_choice_ring_refill_total", {}),
     ("ring_draws", "syz_choice_draws_total", {"source": "ring"}),
     ("ring_underrun", "syz_choice_ring_underrun_total", {}),
+    # crash-triage plane: the signature kernel's fused similarity
+    # dispatch bumps these inside its jit (batches, live report rows,
+    # above-threshold similarity edges)
+    ("triage_batches", "syz_triage_dispatches_total", {}),
+    ("triage_reports", "syz_triage_reports_total", {}),
+    ("triage_edges", "syz_triage_edges_total", {}),
 ]
 
 HIST_SLOTS = [
@@ -66,6 +72,9 @@ HIST_SLOTS = [
     # dispatch→consumable latency of a decision block — the cold-block
     # cost the double-buffered prefetcher hides from consumers
     ("block_consume_latency", "syz_choice_block_consume_seconds"),
+    # end-to-end latency of one triage dedup batch (featurize +
+    # similarity dispatch + label fetch), host-observed
+    ("triage_latency", "syz_triage_batch_seconds"),
 ]
 
 
@@ -218,10 +227,13 @@ class DeviceStats:
         scalar counters plus histogram dicts shaped like
         registry.Histogram.value."""
         vals = self.values()
-        for key, name, labels in SCALAR_SLOTS:
-            yield name, "counter", labels, int(vals[self._slot[key]])
         with self._mu:
             sums = dict(self._hist_sum)
+        yield from self._series_from(vals, sums)
+
+    def _series_from(self, vals: np.ndarray, sums: dict):
+        for key, name, labels in SCALAR_SLOTS:
+            yield name, "counter", labels, int(vals[self._slot[key]])
         for key, name in HIST_SLOTS:
             base = self._hist_base[key]
             buckets = [int(x) for x in vals[base: base + NBUCKETS]]
@@ -243,3 +255,37 @@ class DeviceStats:
         import math
         return [HIST_BASE * (1 << i) for i in range(NBUCKETS - 1)] \
             + [math.inf]
+
+
+def merged_series(stats: "list[DeviceStats]"):
+    """Exposition series summed over several stat vectors.  Subsystems
+    (cover engine, triage kernel) each own a DeviceStats — sharing one
+    vector would race the read-modify-write vec handoff across their
+    unrelated dispatch locks — while /metrics must stay one series per
+    name.  The slot layout is module-static, so summing the int64
+    totals elementwise is exact."""
+    stats = [s for s in stats if s is not None]
+    if not stats:
+        return
+    if len(stats) == 1:
+        yield from stats[0].series()
+        return
+    vals = np.sum([s.values() for s in stats], axis=0)
+    sums = {key: 0.0 for key, _ in HIST_SLOTS}
+    for s in stats:
+        with s._mu:
+            for key, _ in HIST_SLOTS:
+                sums[key] += s._hist_sum[key]
+    yield from stats[0]._series_from(vals, sums)
+
+
+def merged_snapshot(stats: "list[DeviceStats]") -> dict:
+    """snapshot() shape over merged_series (JSON exposition body)."""
+    out: dict = {}
+    for name, _kind, labels, value in merged_series(stats):
+        if labels:
+            k = ",".join(f"{a}={b}" for a, b in sorted(labels.items()))
+            out.setdefault(name, {})[k] = value
+        else:
+            out[name] = value
+    return out

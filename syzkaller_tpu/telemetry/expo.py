@@ -15,9 +15,20 @@ import math
 import os
 import time
 
-from syzkaller_tpu.telemetry.device import DeviceStats
+from syzkaller_tpu.telemetry.device import (
+    DeviceStats, merged_series, merged_snapshot)
 from syzkaller_tpu.telemetry.registry import Registry
 from syzkaller_tpu.telemetry.trace import Tracer
+
+
+def _as_stats_list(device_stats) -> "list[DeviceStats]":
+    """Normalize a DeviceStats | list | None argument: subsystems each
+    own a stat vector; exposition merges them into one series set."""
+    if device_stats is None:
+        return []
+    if isinstance(device_stats, (list, tuple)):
+        return [s for s in device_stats if s is not None]
+    return [device_stats]
 
 
 def _fmt_labels(labels: dict) -> str:
@@ -63,7 +74,7 @@ def _hist_lines(name: str, labels: dict, value: dict,
 
 
 def prometheus_text(registries: "list[Registry]",
-                    device_stats: "DeviceStats | None" = None) -> str:
+                    device_stats=None) -> str:
     """Render every series in `registries` (plus the device stat vector)
     as Prometheus 0.0.4 text exposition."""
     lines: list[str] = []
@@ -89,9 +100,10 @@ def prometheus_text(registries: "list[Registry]",
                 else:
                     lines.append(
                         f"{name}{_fmt_labels(s.labels)} {_fmt_value(v)}")
-    if device_stats is not None:
-        bounds = device_stats.hist_upper_bounds()
-        for name, kind, labels, value in device_stats.series():
+    stats = _as_stats_list(device_stats)
+    if stats:
+        bounds = stats[0].hist_upper_bounds()
+        for name, kind, labels, value in merged_series(stats):
             header(name, kind, "device-resident accumulator "
                    "(telemetry/device.py stat vector)")
             if kind == "histogram":
@@ -103,7 +115,7 @@ def prometheus_text(registries: "list[Registry]",
 
 
 def snapshot(registries: "list[Registry]",
-             device_stats: "DeviceStats | None" = None,
+             device_stats=None,
              tracer: "Tracer | None" = None,
              traces: int = 16) -> dict:
     """JSON-ready snapshot of every registry, the device stat vector,
@@ -111,8 +123,9 @@ def snapshot(registries: "list[Registry]",
     out: dict = {"ts": time.time(), "metrics": {}}
     for reg in registries:
         out["metrics"].update(reg.snapshot())
-    if device_stats is not None:
-        out["device"] = device_stats.snapshot()
+    stats = _as_stats_list(device_stats)
+    if stats:
+        out["device"] = merged_snapshot(stats)
     if tracer is not None:
         out["traces"] = tracer.snapshot(traces)
         out["traces_recorded_total"] = tracer.recorded_total

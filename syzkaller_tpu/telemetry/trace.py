@@ -45,6 +45,10 @@ class SpanContext:
     origin: str = ""              # e.g. the fuzzer/VM name
     hops: "list[Hop]" = field(default_factory=list)
     sent_at: float = 0.0          # stamped by the RPC client at send
+    # lineage edges to OTHER traces (crash → admitting input, repro →
+    # crash): trace ids, so /telemetry consumers can walk the
+    # input→crash→cluster→repro chain across ring entries
+    links: "list[str]" = field(default_factory=list)
 
     def add_hop(self, name: str, dur: float,
                 start: "float | None" = None) -> None:
@@ -63,9 +67,12 @@ class SpanContext:
                                  dur=time.monotonic() - t0))
 
     def to_wire(self) -> dict:
-        return {"trace_id": self.trace_id, "origin": self.origin,
-                "sent_at": self.sent_at,
-                "hops": [h.to_wire() for h in self.hops]}
+        out = {"trace_id": self.trace_id, "origin": self.origin,
+               "sent_at": self.sent_at,
+               "hops": [h.to_wire() for h in self.hops]}
+        if self.links:
+            out["links"] = list(self.links)
+        return out
 
     @classmethod
     def from_wire(cls, d) -> "SpanContext | None":
@@ -73,7 +80,8 @@ class SpanContext:
             return None
         ctx = cls(trace_id=str(d["trace_id"]),
                   origin=str(d.get("origin", "")),
-                  sent_at=float(d.get("sent_at", 0.0)))
+                  sent_at=float(d.get("sent_at", 0.0)),
+                  links=[str(x) for x in d.get("links", [])])
         for h in d.get("hops", []):
             try:
                 ctx.hops.append(Hop(name=str(h["name"]),

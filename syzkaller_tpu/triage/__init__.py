@@ -1,0 +1,24 @@
+"""Crash intelligence plane: device-batched dedup/clustering + the
+batched-bisection repro service.
+
+A million-user fleet produces crash *streams*, not crash files.  This
+package turns the L5 report/repro tier into services:
+
+* `signature` — crash identity as fixed-width feature vectors (title
+  char n-grams + stack-PC frame signature), dedup/clustering as ONE
+  fused batched similarity dispatch on device with a label-propagation
+  union-find, and the incremental `CrashIndex` the manager's
+  `save_crash` dedups through.
+* `scheduler` — `ReproScheduler` packs candidate simplifications of
+  MANY crashes into the same Oracle VM-pool round; per-crash bisection
+  state machines (suspect narrowing → call minimization → option
+  simplification) advance as results return, so repro throughput
+  scales with VM workers instead of crash count.
+* `synth` — oops-corpus-shaped synthetic report generator (bench +
+  load tests).
+"""
+
+from syzkaller_tpu.triage.signature import (  # noqa: F401
+    CrashIndex, SignatureKernel, stable_cluster_id,
+)
+from syzkaller_tpu.triage.scheduler import ReproScheduler  # noqa: F401

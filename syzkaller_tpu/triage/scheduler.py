@@ -1,0 +1,284 @@
+"""Batched-bisection repro service: many crashes, one VM pool.
+
+The legacy path bisected one crash per dedicated thread+VM-block —
+repro latency scaled with crash count.  Here every crash is a
+*bisection state machine* (the `repro.run_steps` generator: suspect
+narrowing → call minimization → option simplification) and the
+scheduler packs the currently-runnable candidate tests of ALL active
+crashes into rounds over ONE shared `Oracle` pool: each round is a
+`first_crasher`-style fan-out of up to `workers` mixed work units, and
+state machines advance as their answers resolve.  Total wall rounds
+approach ceil(total-candidates / workers) + the deepest single
+machine's sequential depth, instead of the sum of every crash's serial
+chain.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from dataclasses import dataclass, field
+
+from syzkaller_tpu import repro as repro_mod
+from syzkaller_tpu.utils import log
+
+
+@dataclass
+class _Job:
+    title: str
+    crash_dir: str
+    gen: object                       # repro.run_steps generator
+    links: tuple = ()
+    pending: object = None            # TestBatch | TestOne in flight
+    answers: dict = field(default_factory=dict)
+    sent: int = 0                     # next pending item not yet packed
+    rounds: int = 0
+    tests: int = 0
+    phase_time: dict = field(default_factory=dict)
+    started: float = field(default_factory=time.monotonic)
+    result: object = None
+    failed: "str | None" = None
+
+
+class ReproScheduler:
+    """Drives many `repro.run_steps` machines against one Oracle pool.
+
+    `submit` is non-blocking (dedups on title); a background loop packs
+    rounds while any job is active and invokes `on_done(title,
+    crash_dir, result, job)` as each finishes.  Candidate answers and
+    generator advancement happen on the loop thread — the only
+    concurrency is the per-round worker fan-out, which reuses the
+    Oracle's worker-id pinning (`_test_on`) so unit k of a round runs
+    on pool machine k, exactly like `first_crasher`.
+    """
+
+    def __init__(self, oracle, table, *, quick: float = 10.0,
+                 thorough: float = 300.0, with_c_repro: bool = True,
+                 c_test_fn=None, on_done=None, tracer=None,
+                 metrics: "dict | None" = None):
+        self.oracle = repro_mod._as_oracle(oracle)
+        self.table = table
+        self.quick = quick
+        self.thorough = thorough
+        self.with_c_repro = with_c_repro
+        self.c_test_fn = c_test_fn
+        self.on_done = on_done
+        self.tracer = tracer
+        self.metrics = metrics or {}
+        self._cv = threading.Condition()
+        self._queue: "deque[_Job]" = deque()
+        self._active: "list[_Job]" = []
+        self._titles: "set[str]" = set()
+        self._stopped = False
+        self.stat_rounds = 0
+        self.stat_tests = 0
+        self.stat_jobs_done = 0
+        self._thread = threading.Thread(target=self._loop,
+                                        name="repro-scheduler",
+                                        daemon=True)
+        self._thread.start()
+
+    # -- intake ------------------------------------------------------------
+
+    def submit(self, crash_log: bytes, title: str, crash_dir: str,
+               links: tuple = ()) -> bool:
+        """Queue one crash for batched bisection; False if a job for
+        this title is already queued/active or the service stopped."""
+        with self._cv:
+            if self._stopped or title in self._titles:
+                return False
+            gen = repro_mod.run_steps(
+                crash_log, self.table, with_c_repro=self.with_c_repro,
+                c_test_fn=self.c_test_fn, quick=self.quick,
+                thorough=self.thorough)
+            job = _Job(title=title, crash_dir=crash_dir, gen=gen,
+                       links=tuple(links))
+            self._titles.add(title)
+            self._queue.append(job)
+            self._cv.notify()
+        return True
+
+    @property
+    def depth(self) -> int:
+        with self._cv:
+            return len(self._queue) + len(self._active)
+
+    def join(self, timeout: "float | None" = None) -> bool:
+        """Wait until no job is queued or active."""
+        deadline = None if timeout is None else time.monotonic() + timeout
+        with self._cv:
+            while self._queue or self._active:
+                left = None if deadline is None \
+                    else deadline - time.monotonic()
+                if left is not None and left <= 0:
+                    return False
+                self._cv.wait(timeout=left if left is not None else 0.5)
+        return True
+
+    def stop(self) -> None:
+        with self._cv:
+            self._stopped = True
+            self._cv.notify_all()
+        self._thread.join(timeout=10.0)
+
+    # -- the round loop ----------------------------------------------------
+
+    def _loop(self) -> None:
+        while True:
+            with self._cv:
+                while not self._queue and not self._active \
+                        and not self._stopped:
+                    self._cv.wait(timeout=0.5)
+                if self._stopped:
+                    return
+                while self._queue:
+                    self._active.append(self._queue.popleft())
+            # prime newly admitted machines to their first request
+            for job in list(self._active):
+                if job.pending is None and job.result is None:
+                    self._advance(job, _prime=True)
+            self._reap()
+            units = self._gather()
+            if not units:
+                continue
+            self._run_round(units)
+            self._resolve()
+            self._reap()
+
+    def _advance(self, job: _Job, answer=None, _prime=False) -> None:
+        try:
+            req = next(job.gen) if _prime else job.gen.send(answer)
+            job.pending, job.answers, job.sent = req, {}, 0
+        except StopIteration as s:
+            job.pending, job.result = None, s.value
+            job.failed = None
+        except Exception as e:            # a broken machine or log must
+            log.logf(0, "repro job %r error: %s", job.title, e)
+            job.pending, job.result = None, None
+            job.failed = str(e)
+
+    def _gather(self) -> list:
+        """Pack up to `workers` units breadth-first across active jobs
+        (one unit per job per sweep, so a wide TestBatch cannot starve
+        the other machines)."""
+        W = self.oracle.workers
+        units: list = []                  # (job, item_idx, data, opts, dur)
+        progressed = True
+        while len(units) < W and progressed:
+            progressed = False
+            for job in self._active:
+                if len(units) >= W:
+                    break
+                req = job.pending
+                if req is None:
+                    continue
+                if isinstance(req, repro_mod.TestBatch):
+                    if job.sent < len(req.items):
+                        data, opts = req.items[job.sent]
+                        units.append((job, job.sent, data, opts,
+                                      req.duration))
+                        job.sent += 1
+                        progressed = True
+                elif job.sent == 0:       # TestOne
+                    units.append((job, 0, req.data, req.opts,
+                                  req.duration))
+                    job.sent = 1
+                    progressed = True
+        return units
+
+    def _run_round(self, units: list) -> None:
+        """One VM-pool fan-out: unit k on oracle worker k."""
+        t0 = time.monotonic()
+        results = self.oracle.test_many(
+            [(d, o, dur) for _j, _i, d, o, dur in units])
+        dt = time.monotonic() - t0
+        self.stat_rounds += 1
+        self.stat_tests += len(units)
+        m = self.metrics.get("rounds")
+        if m is not None:
+            m.inc()
+        m = self.metrics.get("tests")
+        if m is not None:
+            m.inc(len(units))
+        touched = set()
+        for (job, i, _d, _o, _dur), hit in zip(units, results):
+            job.answers[i] = bool(hit)
+            job.tests += 1
+            if id(job) not in touched:
+                touched.add(id(job))
+                job.rounds += 1
+                phase = getattr(job.pending, "phase", "") or "?"
+                job.phase_time[phase] = job.phase_time.get(phase, 0.0) + dt
+
+    def _resolve(self) -> None:
+        for job in self._active:
+            req = job.pending
+            if req is None:
+                continue
+            if isinstance(req, repro_mod.TestBatch):
+                ans, final = self._batch_verdict(job, req)
+                if final:
+                    # early-cancel: once the earliest remaining
+                    # candidate is confirmed, unsent later items are
+                    # never packed into a round
+                    self._advance(job, ans)
+            else:
+                if 0 in job.answers:
+                    self._advance(job, job.answers[0])
+
+    @staticmethod
+    def _batch_verdict(job: _Job, req) -> "tuple[int | None, bool]":
+        """first_crasher semantics over incrementally arriving answers:
+        final once the earliest crasher has no unanswered earlier item,
+        or every item answered False."""
+        for i in range(len(req.items)):
+            r = job.answers.get(i)
+            if r is True:
+                return i, True
+            if r is None:
+                return None, False
+        return None, True
+
+    def _reap(self) -> None:
+        done = [j for j in self._active if j.pending is None]
+        if not done:
+            return
+        for job in done:
+            self.stat_jobs_done += 1
+            self._record_trace(job)
+            m = self.metrics.get("jobs")
+            if m is not None:
+                out = "error" if job.failed else (
+                    "found" if job.result is not None
+                    and getattr(job.result, "prog", None) is not None
+                    else "failed")
+                m.labels(outcome=out).inc()
+            if self.on_done is not None:
+                try:
+                    self.on_done(job.title, job.crash_dir, job.result, job)
+                except Exception as e:
+                    log.logf(0, "repro on_done for %r failed: %s",
+                             job.title, e)
+        # jobs leave _active only AFTER their artifacts callback ran,
+        # so join() returning implies every completed job is persisted
+        with self._cv:
+            self._active = [j for j in self._active
+                            if j.pending is not None]
+            for job in done:
+                self._titles.discard(job.title)
+            self._cv.notify_all()
+
+    def _record_trace(self, job: _Job) -> None:
+        """crash → repro lineage: one span per bisection phase, linked
+        back to the crash trace (which links to the admitting input)."""
+        if self.tracer is None:
+            return
+        ctx = self.tracer.new_trace(origin=f"repro:{job.title}")
+        ctx.links = list(job.links)
+        for phase, t in job.phase_time.items():
+            ctx.add_hop(f"repro:{phase}", t)
+        self.tracer.record(
+            ctx, final_hop=(f"repro:done rounds={job.rounds} "
+                            f"tests={job.tests}"),
+            dur=time.monotonic() - job.started)

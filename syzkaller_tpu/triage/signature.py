@@ -1,0 +1,336 @@
+"""Signature kernel: vectorized crash identity + device-batched
+dedup/clustering.
+
+A crash's identity is a fixed-width L2-normalized feature vector:
+character 4-gram hashes of the oops title (the description
+`report.parse` extracts — the reference's dedup key) concatenated with
+a down-weighted bag of stack-PC frame-name hashes (`report.Report
+.frames`).  Clustering a batch is then ONE fused device dispatch:
+cosine similarity as a blocked matmul, threshold to an adjacency
+matrix, and a min-label propagation loop (the device-native
+union-find) that converges to per-component representative indices —
+batch shapes pow2-bucketed so warm batches never recompile, telemetry
+stat bumps folded in INSIDE the jit (cover-engine idiom).
+
+Parameter provenance (pinned by tests/test_triage.py golden corpus):
+on the 43-log oops regression corpus, 4-gram title cosine between
+DISTINCT crash classes peaks at 0.853 (`nr_ptes` vs `nr_pmds` — one
+letter apart, genuinely different kernel bugs), while identical titles
+score 1.0.  With the 0.3-weighted frame block appended, inter-class
+similarity is bounded by (0.853 + 0.09)/1.09 ≈ 0.865 and same-title
+pairs by 1/1.09 ≈ 0.917 (disjoint frames) — THRESHOLD 0.89 separates
+both with margin, tolerating title noise (addresses, truncation) that
+string-equality dedup fragments into duplicate buckets.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import re
+import threading
+import zlib
+from dataclasses import dataclass
+
+import numpy as np
+
+from syzkaller_tpu.utils.shapes import pow2_bucket
+
+D_TITLE = 512        # title 4-gram hash buckets
+D_FRAME = 256        # frame-name hash buckets
+NGRAM = 4
+FRAME_WEIGHT = 0.3   # frame block scale vs the unit-norm title block
+THRESHOLD = 0.89
+
+# decimal runs collapse to one token before n-gramming: sizes, line
+# numbers and pids are per-instance noise ("Read of size 8" vs "of
+# size 16" is one bug), while identifier spellings (nr_ptes/nr_pmds)
+# stay intact and keep distinct classes apart
+_DIGIT_RUN = re.compile(rb"[0-9]+")
+
+
+def stable_cluster_id(title: str) -> str:
+    """Cluster id minted from the founding member's title — the same
+    sha1-prefix scheme the manager's crash dirs always used, so
+    restarts (and pre-triage workdirs) resolve to identical ids."""
+    return hashlib.sha1(title.encode()).hexdigest()[:40]
+
+
+def featurize_one(title: str, frames: "list[str] | None" = None
+                  ) -> np.ndarray:
+    """(D_TITLE + D_FRAME,) float32, L2-normalized."""
+    v = np.zeros((D_TITLE + D_FRAME,), np.float32)
+    t = _DIGIT_RUN.sub(b"#", title.lower().encode())
+    if len(t) < NGRAM:
+        if t:
+            v[zlib.crc32(t) % D_TITLE] += 1.0
+    else:
+        for i in range(len(t) - NGRAM + 1):
+            v[zlib.crc32(t[i:i + NGRAM]) % D_TITLE] += 1.0
+    tn = float(np.linalg.norm(v[:D_TITLE]))
+    if tn > 0:
+        v[:D_TITLE] /= tn
+    if frames:
+        f = v[D_TITLE:]
+        for name in frames:
+            f[zlib.crc32(name.encode()) % D_FRAME] += 1.0
+        fn = float(np.linalg.norm(f))
+        if fn > 0:
+            f *= FRAME_WEIGHT / fn
+    n = float(np.linalg.norm(v))
+    if n > 0:
+        v /= n
+    return v
+
+
+class SignatureKernel:
+    """The batched dedup/clustering dispatch.
+
+    `cluster(feats)` pads the batch to a pow2 bucket and runs ONE
+    jitted call: blocked similarity matmul → thresholded adjacency →
+    min-label propagation to a fixpoint.  Returns per-row component
+    labels (the min row index of each connected component).  Telemetry
+    (a telemetry.device.DeviceStats) is bumped inside the jit —
+    batches, live rows, above-threshold edges — plus a host-observed
+    end-to-end latency histogram.
+    """
+
+    D = D_TITLE + D_FRAME
+
+    def __init__(self, threshold: float = THRESHOLD, telemetry=None,
+                 min_batch: int = 64, max_batch: int = 1 << 14,
+                 row_block: int = 1024):
+        self.threshold = float(threshold)
+        self.tstats = telemetry
+        self.min_batch = min_batch
+        self.max_batch = max_batch
+        self.row_block = row_block
+        self._mu = threading.Lock()
+        self._cluster_fn = None      # built lazily on first device use
+        self._ts_dummy = None
+
+    # -- featurization (host) ---------------------------------------------
+
+    def featurize(self, reports: "list[tuple[str, list[str]]]"
+                  ) -> np.ndarray:
+        """(n, D) feature matrix for [(title, frames), ...]."""
+        if not reports:
+            return np.zeros((0, self.D), np.float32)
+        return np.stack([featurize_one(t, f) for t, f in reports])
+
+    # -- the fused dispatch ------------------------------------------------
+
+    def _build(self) -> None:
+        import jax
+        import jax.numpy as jnp
+
+        ds = self.tstats
+        thr = self.threshold
+        rb = self.row_block
+        self._ts_dummy = jnp.zeros((1,), jnp.int32)
+
+        @jax.jit
+        def _cluster(feats, svec, hinc):
+            B = feats.shape[0]
+            blk = rb if B >= rb else B
+            nb = B // blk                 # both pow2 → exact
+
+            def sim_block(i):
+                f = jax.lax.dynamic_slice_in_dim(feats, i * blk, blk)
+                return (f @ feats.T) >= thr
+
+            adj = jax.lax.map(sim_block, jnp.arange(nb)).reshape(B, B)
+            adj = adj | adj.T | jnp.eye(B, dtype=bool)
+
+            def prop(state):
+                labels, _ = state
+
+                def row_min(i):
+                    a = jax.lax.dynamic_slice_in_dim(adj, i * blk, blk)
+                    return jnp.min(jnp.where(a, labels[None, :], B),
+                                   axis=1)
+
+                new = jax.lax.map(row_min, jnp.arange(nb)) \
+                    .reshape(B).astype(jnp.int32)
+                return jnp.minimum(labels, new), labels
+
+            init = jnp.arange(B, dtype=jnp.int32)
+            labels, _ = jax.lax.while_loop(
+                lambda s: jnp.any(s[0] != s[1]), prop, (init, init - 1))
+            if ds is not None:
+                svec = svec + hinc
+                svec = svec.at[ds.slot("triage_batches")].add(1)
+                svec = svec.at[ds.slot("triage_reports")].add(
+                    jnp.sum(jnp.any(feats != 0, axis=1),
+                            dtype=jnp.int32))
+                svec = svec.at[ds.slot("triage_edges")].add(
+                    (jnp.sum(adj, dtype=jnp.int32) - B) // 2)
+            return labels, svec
+
+        self._cluster_fn = _cluster
+
+    def _ts_in(self):
+        if self.tstats is None:
+            return self._ts_dummy, self._ts_dummy
+        return self.tstats.vec, self.tstats.take_pending_device()
+
+    def cluster(self, feats: np.ndarray) -> np.ndarray:
+        """(n,) int32 cluster labels for an (n, D) feature batch; label
+        = min member row index per connected component.  One fused
+        dispatch; batches above max_batch must be chunked through a
+        CrashIndex (whose representatives carry identity across
+        chunks)."""
+        import time
+
+        n = int(feats.shape[0])
+        if n == 0:
+            return np.zeros((0,), np.int32)
+        if n > self.max_batch:
+            raise ValueError(
+                f"batch {n} > max_batch {self.max_batch}; chunk via "
+                "CrashIndex.assign")
+        t0 = time.monotonic()
+        B = pow2_bucket(n, self.min_batch, self.max_batch)
+        padded = np.zeros((B, self.D), np.float32)
+        padded[:n] = feats
+        with self._mu:
+            if self._cluster_fn is None:
+                self._build()
+            svec, hinc = self._ts_in()
+            labels, svec = self._cluster_fn(padded, svec, hinc)
+            if self.tstats is not None:
+                self.tstats.commit(svec)
+        # the label fetch is the only host sync — outside the lock
+        out = np.asarray(labels)[:n]
+        if self.tstats is not None:
+            self.tstats.observe("triage_latency", time.monotonic() - t0)
+        return out
+
+
+# -- incremental cluster index ----------------------------------------------
+
+
+@dataclass
+class Cluster:
+    cid: str                # stable id (founding member's title sha1)
+    title: str              # representative (founding) title
+    feat: np.ndarray        # founding member's feature vector
+    count: int = 0          # crashes assigned
+
+
+class CrashIndex:
+    """Incremental clustering over the signature kernel: cluster
+    representatives persist across batches, so ids are stable while
+    arbitrary batch sizes stream through.  `assign` runs ONE fused
+    dispatch over [representatives ++ batch]; a report landing in a
+    component that contains a representative joins that cluster, a
+    representative-free component founds a new one.
+
+    The internal lock guards host bookkeeping only — the device
+    dispatch runs outside it; the representative-set-moved-underneath
+    race is resolved host-side with a handful of exact dot products
+    against representatives added since the snapshot."""
+
+    def __init__(self, kernel: "SignatureKernel | None" = None,
+                 telemetry=None):
+        self.kernel = kernel or SignatureKernel(telemetry=telemetry)
+        self._mu = threading.Lock()
+        self._clusters: "list[Cluster]" = []
+        self._by_id: "dict[str, Cluster]" = {}
+        self.assigned_total = 0
+
+    def __len__(self) -> int:
+        with self._mu:
+            return len(self._clusters)
+
+    def clusters(self) -> "list[Cluster]":
+        with self._mu:
+            return list(self._clusters)
+
+    def counts(self) -> "dict[str, int]":
+        with self._mu:
+            return {c.cid: c.count for c in self._clusters}
+
+    def rebuild(self, entries: "list[tuple[str, str, list[str], int]]"
+                ) -> None:
+        """Restore representatives from persisted crash state:
+        (cluster_id, title, frames, count) per cluster dir.  Trusts the
+        stored ids — no device work, so manager startup stays cheap."""
+        with self._mu:
+            for cid, title, frames, count in entries:
+                if cid in self._by_id:
+                    self._by_id[cid].count += count
+                    continue
+                c = Cluster(cid=cid, title=title,
+                            feat=featurize_one(title, frames),
+                            count=count)
+                self._clusters.append(c)
+                self._by_id[cid] = c
+
+    def assign(self, reports: "list[tuple[str, list[str]]]",
+               counts: "list[int] | None" = None) -> "list[str]":
+        """Cluster ids for a batch of parsed reports (title, frames).
+        Chunks transparently when representatives + batch exceed the
+        kernel's max batch."""
+        if not reports:
+            return []
+        out: "list[str]" = []
+        cap = self.kernel.max_batch
+        step = max(1, cap - len(self._clusters) - 64)
+        for lo in range(0, len(reports), step):
+            chunk = reports[lo:lo + step]
+            cc = counts[lo:lo + step] if counts is not None else None
+            out.extend(self._assign_chunk(chunk, cc))
+        return out
+
+    def _assign_chunk(self, reports, counts) -> "list[str]":
+        feats = self.kernel.featurize(reports)
+        with self._mu:
+            reps = list(self._clusters)
+        nreps = len(reps)
+        if reps:
+            allf = np.concatenate(
+                [np.stack([c.feat for c in reps]), feats])
+        else:
+            allf = feats
+        labels = self.kernel.cluster(allf)          # device, lock-free
+        comp: "dict[int, list[int]]" = {}
+        for i, lab in enumerate(labels):
+            comp.setdefault(int(lab), []).append(i)
+        out: "list[str | None]" = [None] * len(reports)
+        with self._mu:
+            added_since = self._clusters[nreps:]
+            for members in comp.values():
+                new = [i - nreps for i in members if i >= nreps]
+                if not new:
+                    continue
+                old = [i for i in members if i < nreps]
+                if old:
+                    # joins an existing cluster; if the batch bridged
+                    # two historical clusters, keep both and file under
+                    # the older one (id stability beats merging)
+                    cl = reps[min(old)]
+                else:
+                    cl = self._resolve_new(reports[new[0]][0],
+                                           feats[new[0]], added_since)
+                for j in new:
+                    cl.count += counts[j] if counts is not None else 1
+                    out[j] = cl.cid
+            self.assigned_total += len(reports)
+        return out                                   # type: ignore
+
+    def _resolve_new(self, title: str, feat: np.ndarray,
+                     added_since: "list[Cluster]") -> Cluster:
+        """Under _mu: found a cluster for a representative-free
+        component, first re-checking representatives a concurrent
+        assign added after our snapshot (exact same cosine metric,
+        host-side — a few dot products)."""
+        for c in added_since:
+            if float(np.dot(feat, c.feat)) >= self.kernel.threshold:
+                return c
+        cid = stable_cluster_id(title)
+        c = self._by_id.get(cid)
+        if c is None:
+            c = Cluster(cid=cid, title=title, feat=feat.copy())
+            self._clusters.append(c)
+            self._by_id[cid] = c
+        return c

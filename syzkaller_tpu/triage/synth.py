@@ -1,0 +1,85 @@
+"""Oops-corpus-shaped synthetic report generator.
+
+Produces parsed reports — (title, frames) tuples, the signature
+kernel's input — distributionally shaped like the 43-log regression
+corpus (tests/test_oops_corpus.py): a bounded set of crash classes
+(KASAN/KMSAN access reports, GPFs, deadlocks, hangs, BUG_ONs, leaks)
+instantiated over a pool of kernel function names, with the per-report
+noise a real fleet stream carries (sizes, line numbers, slightly
+jittered frame tails).  Reports generated from the same (class,
+function) template are the same crash and must dedup together; bench
+uses the known template count as the expected cluster cardinality.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+_FUNCS = [
+    "tcp_v4_connect", "skb_release_data", "ext4_mark_inode_dirty",
+    "sk_psock_init", "snd_pcm_period_elapsed", "copy_process",
+    "pipe_lock", "rb_erase", "kfree_skb", "tcp_close", "sock_has_perm",
+    "__list_del_entry", "relay_switch_subbuf", "__tcp_select_window",
+    "sk_stream_kill_queues", "ksys_write", "timerqueue_del", "memcpy",
+    "__schedule", "strlen",
+]
+
+_TRACE_FUNCS = [
+    "do_syscall_64", "entry_SYSCALL_64", "sock_sendmsg", "vfs_write",
+    "ksys_write", "do_sys_open", "path_openat", "link_path_walk",
+    "security_socket_sendmsg", "release_sock", "lock_sock_nested",
+    "tcp_sendmsg", "inet_release", "__sock_release", "sock_close",
+    "__fput", "task_work_run", "exit_to_user_mode",
+]
+
+# (title template, has size noise, has frames) — {f}: function name
+_CLASSES = [
+    ("KASAN: use-after-free Read in {f}", True, True),
+    ("KASAN: use-after-free Write in {f}", True, True),
+    ("KASAN: slab-out-of-bounds Read in {f}", True, True),
+    ("KMSAN: uninit-value in {f}", False, True),
+    ("KCSAN: data-race in {f}", False, False),
+    ("general protection fault in {f}", False, True),
+    ("possible deadlock in {f}", False, True),
+    ("WARNING in {f}", False, True),
+    ("BUG: unable to handle kernel NULL pointer dereference in {f}",
+     False, True),
+    ("memory leak in {f} (size {n})", False, True),
+    ("INFO: task hung", False, False),
+    ("INFO: rcu detected stall", False, False),
+]
+
+
+def templates(n_templates: int, seed: int = 0):
+    """n distinct crash templates: (title_fmt, func, frames)."""
+    rng = np.random.default_rng(seed)
+    out = []
+    for i in range(n_templates):
+        cls, noisy_size, has_frames = _CLASSES[i % len(_CLASSES)]
+        func = _FUNCS[(i // len(_CLASSES)) % len(_FUNCS)]
+        frames = []
+        if has_frames:
+            start = int(rng.integers(0, len(_TRACE_FUNCS) - 4))
+            frames = [func] + _TRACE_FUNCS[start:start + 4]
+        out.append((cls, func, frames, noisy_size))
+    return out
+
+
+def reports(rng, n: int, n_templates: int = 40
+            ) -> "list[tuple[str, list[str]]]":
+    """n synthetic parsed reports drawn over `n_templates` distinct
+    crashes.  Same-template reports vary only in noise a real console
+    stream carries (sizes in the title where the class embeds one, a
+    jittered frame tail) — they must land in one cluster."""
+    tpls = templates(n_templates)
+    out = []
+    for _ in range(n):
+        cls, func, frames, noisy_size = tpls[int(rng.integers(len(tpls)))]
+        title = cls.replace("{f}", func)
+        if "{n}" in title:
+            title = title.replace("{n}", str(1 << int(rng.integers(5, 12))))
+        fr = list(frames)
+        if fr and rng.random() < 0.3:
+            fr = fr[:-1]          # truncated unwind tail
+        out.append((title, fr))
+    return out

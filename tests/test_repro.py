@@ -204,3 +204,87 @@ def test_repro_parallel_oracle(table):
     assert result is not None and result.prog is not None
     assert concurrency["max"] >= 2, "suspect scan did not parallelize"
     assert len(seen_wids) >= 2, "only one worker instance used"
+
+
+def test_first_crasher_early_cancel():
+    """Once the earliest remaining candidate is a confirmed crasher,
+    workers drain the queue instead of testing strictly-later items:
+    with item 0 crashing fast, only the in-flight items (at most one
+    per worker) are ever spent — pinned via the saved test
+    invocations (Oracle.last_tested)."""
+    import threading as threading_mod
+    import time as time_mod
+
+    class Orc(repro_pkg.Oracle):
+        def __init__(self):
+            super().__init__(self._t, workers=2)
+
+        def _t(self, data, opts, duration):
+            return self._test_on(0, data, opts, duration)
+
+        def _test_on(self, wid, data, opts, duration):
+            if data == b"crash":
+                time_mod.sleep(0.01)
+                return True
+            time_mod.sleep(0.15)
+            return False
+
+    oracle = Orc()
+    items = [(b"crash" if i == 0 else b"boring%d" % i, None)
+             for i in range(8)]
+    t0 = time_mod.monotonic()
+    assert oracle.first_crasher(items, 0.1) == 0
+    dt = time_mod.monotonic() - t0
+    tested = set(oracle.last_tested)
+    assert 0 in tested
+    # only items dequeued before item 0 confirmed were spent: both
+    # workers started one item each, everything later was drained
+    assert tested <= {0, 1}, tested
+    assert dt < 1.0          # not 8 sequential 0.15s tests
+
+    # the answer still prefers EARLIER candidates: a late fast crasher
+    # must not cancel earlier in-flight candidates
+    class LateOrc(repro_pkg.Oracle):
+        def __init__(self):
+            super().__init__(self._t, workers=4)
+
+        def _t(self, data, opts, duration):
+            return self._test_on(0, data, opts, duration)
+
+        def _test_on(self, wid, data, opts, duration):
+            if data == b"late":
+                return True               # instant crash at index 3
+            time_mod.sleep(0.05)
+            return data == b"early"       # slower crash at index 0
+
+    late = LateOrc()
+    hit = late.first_crasher(
+        [(b"early", None), (b"b1", None), (b"b2", None), (b"late", None)],
+        0.1)
+    assert hit == 0
+
+
+def test_test_many_runs_all_units(table):
+    """test_many (the repro scheduler's round primitive) returns every
+    verdict — mixed consumers, no early-cancel — and pins unit k to
+    worker k."""
+    seen = []
+    mu = __import__("threading").Lock()
+
+    class Orc(repro_pkg.Oracle):
+        def __init__(self):
+            super().__init__(self._t, workers=4)
+
+        def _t(self, data, opts, duration):
+            return self._test_on(0, data, opts, duration)
+
+        def _test_on(self, wid, data, opts, duration):
+            with mu:
+                seen.append((wid, data))
+            return data == b"hit"
+
+    orc = Orc()
+    out = orc.test_many([(b"hit", None, 0.1), (b"miss", None, 0.1),
+                         (b"hit", None, 0.1)])
+    assert out == [True, False, True]
+    assert sorted(w for w, _ in seen) == [0, 1, 2]

@@ -1,0 +1,322 @@
+"""Crash intelligence plane tests: signature-kernel golden clustering
+over the full 43-log oops corpus, compile-count pins across batch-size
+buckets, the incremental CrashIndex, manager crash-state restart
+rebuild, and the batched-bisection repro scheduler's round bound."""
+
+import math
+import os
+import threading
+import time
+from dataclasses import dataclass
+
+import numpy as np
+import pytest
+
+from test_oops_corpus import CORPUS, _log
+
+from syzkaller_tpu import repro as repro_pkg
+from syzkaller_tpu.report import report
+from syzkaller_tpu.sys.table import load_table
+from syzkaller_tpu.telemetry import DeviceStats, SpanContext
+from syzkaller_tpu.triage import (
+    CrashIndex, ReproScheduler, SignatureKernel, stable_cluster_id)
+from syzkaller_tpu.triage import synth
+from syzkaller_tpu.vet.runtime import CompileCounter
+
+
+@pytest.fixture(scope="module")
+def parsed_corpus():
+    """The 43 oops logs parsed to (description, frames)."""
+    out = []
+    for name, body, want in CORPUS:
+        rep = report.parse(_log(body))
+        assert rep is not None and rep.description == want, name
+        out.append((rep.description, rep.frames))
+    return out
+
+
+@pytest.fixture(scope="module")
+def table():
+    return load_table(files=["probe.txt"])
+
+
+# -- signature kernel -------------------------------------------------------
+
+
+def test_corpus_golden_clusters(parsed_corpus):
+    """Golden cluster assignments on the full corpus in ONE batch:
+    same-class oopses (equal descriptions — the three rcu-stall logs)
+    cluster together, distinct classes stay apart.  This pins the
+    featurization (4-gram digit-collapsed titles + weighted frames) and
+    THRESHOLD against the realistic console formats."""
+    kern = SignatureKernel()
+    labels = kern.cluster(kern.featurize(parsed_corpus))
+    by_desc: dict = {}
+    for i, (desc, _f) in enumerate(parsed_corpus):
+        by_desc.setdefault(desc, set()).add(int(labels[i]))
+    for desc, labs in by_desc.items():
+        assert len(labs) == 1, f"class split: {desc} -> {labs}"
+    lab_of = {d: labs.pop() for d, labs in by_desc.items()}
+    assert len(set(lab_of.values())) == len(lab_of), \
+        "distinct crash classes merged into one cluster"
+    # the corpus's known structure: 3 rcu logs share one description
+    assert len(lab_of) == len(CORPUS) - 2
+
+
+def test_title_noise_clusters_together():
+    """Per-instance noise (sizes, line numbers, truncated frame tails)
+    must dedup into one cluster — the case title-string equality
+    fragments into duplicate buckets."""
+    kern = SignatureKernel()
+    reps = [
+        ("KASAN: wild-memory-access Write of size 8", []),
+        ("KASAN: wild-memory-access Write of size 16", []),
+        ("memory leak in sk_psock_init (size 1024)",
+         ["sk_psock_init", "sock_sendmsg", "do_syscall_64"]),
+        ("memory leak in sk_psock_init (size 512)",
+         ["sk_psock_init", "sock_sendmsg"]),
+        # distinct one-letter-apart kernel bugs stay apart
+        ("BUG: non-zero nr_ptes on freeing mm", []),
+        ("BUG: non-zero nr_pmds on freeing mm", []),
+    ]
+    labels = kern.cluster(kern.featurize(reps))
+    assert labels[0] == labels[1]
+    assert labels[2] == labels[3]
+    assert labels[4] != labels[5]
+    assert labels[0] != labels[2] != labels[4]
+
+
+def test_similarity_compile_pin():
+    """Zero warm recompiles across batch-size buckets: the similarity
+    dispatch compiles once per pow2 bucket, then every batch size
+    inside a warmed bucket reuses it."""
+    kern = SignatureKernel(min_batch=64)
+    rng = np.random.default_rng(3)
+    # warm both buckets (64 and 128)
+    kern.cluster(kern.featurize(synth.reports(rng, 40)))
+    kern.cluster(kern.featurize(synth.reports(rng, 100)))
+    with CompileCounter() as cc:
+        for n in (17, 43, 64, 70, 101, 128):
+            kern.cluster(kern.featurize(synth.reports(rng, n)))
+    assert cc.count == 0, cc.events
+
+
+def test_kernel_telemetry_bumped_in_dispatch():
+    ds = DeviceStats()
+    kern = SignatureKernel(telemetry=ds)
+    reps = [("WARNING in copy_process", []),
+            ("WARNING in copy_process", []),
+            ("INFO: task hung", [])]
+    kern.cluster(kern.featurize(reps))
+    snap = ds.snapshot()
+    assert snap["syz_triage_dispatches_total"] == 1
+    assert snap["syz_triage_reports_total"] == 3
+    assert snap["syz_triage_edges_total"] >= 1    # the duplicate pair
+    assert snap["syz_triage_batch_seconds"]["count"] == 1
+
+
+def test_crash_index_incremental_stable_ids(parsed_corpus):
+    """Cluster ids are stable: joining a later batch lands in the same
+    cluster; a rebuilt index (the restart path) keeps the persisted
+    ids and keeps deduping into them."""
+    idx = CrashIndex()
+    ids = idx.assign(parsed_corpus)
+    assert len(ids) == len(parsed_corpus)
+    assert len(idx) == len(CORPUS) - 2
+    # same-class rejoin, different noise
+    again = idx.assign([("INFO: rcu detected stall", [])])[0]
+    rcu = [i for (d, _), i in zip(parsed_corpus, ids)
+           if d == "INFO: rcu detected stall"]
+    assert again == rcu[0] and len(set(rcu)) == 1
+    assert len(idx) == len(CORPUS) - 2            # no new cluster
+    # restart: rebuild from (cid, title, frames, count) persistence
+    entries = [(c.cid, c.title, [], c.count) for c in idx.clusters()]
+    idx2 = CrashIndex()
+    idx2.rebuild(entries)
+    assert len(idx2) == len(idx)
+    assert idx2.assign([parsed_corpus[0]])[0] == ids[0]
+    assert idx2.counts()[ids[0]] >= idx.counts()[ids[0]]
+
+
+def test_cluster_id_scheme_matches_legacy_dirs():
+    """Fresh clusters mint the sha1-prefix id the manager's crash dirs
+    always used, so pre-triage workdirs rebuild losslessly."""
+    import hashlib
+    t = "KASAN: use-after-free Read in foo"
+    assert stable_cluster_id(t) == \
+        hashlib.sha1(t.encode()).hexdigest()[:40]
+
+
+# -- manager integration: cluster dedup + restart rebuild -------------------
+
+
+@dataclass
+class FakeOutcome:
+    title: str
+    output: bytes
+    report: object
+    crashed: bool = True
+    timed_out: bool = False
+
+
+def _outcome(log_bytes: bytes) -> FakeOutcome:
+    rep = report.parse(log_bytes)
+    assert rep is not None
+    return FakeOutcome(rep.description, log_bytes, rep)
+
+
+def test_manager_crash_dedup_and_restart(tmp_path):
+    from syzkaller_tpu.manager.config import Config
+    from syzkaller_tpu.manager.manager import Manager
+
+    cfg = Config(workdir=str(tmp_path), type="local", count=1,
+                 descriptions="probe.txt", npcs=1 << 12, corpus_cap=64,
+                 http="", reproduce=False)
+    mgr = Manager(cfg)
+    try:
+        d1 = mgr.save_crash(_outcome(
+            b"[ 1.0] BUG: KASAN: wild-memory-access on address dead0110\n"
+            b"[ 1.1] Write of size 8 by task a/1\n"))
+        d2 = mgr.save_crash(_outcome(
+            b"[ 2.0] BUG: KASAN: wild-memory-access on address dead0220\n"
+            b"[ 2.1] Write of size 16 by task b/2\n"))
+        d3 = mgr.save_crash(_outcome(
+            b"[ 3.0] BUG: spinlock recursion on CPU#1, c/3\n"))
+        # noisy size variants of one bug share a cluster dir; a
+        # distinct bug class gets its own
+        assert d1 == d2 and d1 != d3
+        assert len(mgr.crash_index) == 2
+        assert len(os.listdir(os.path.join(str(tmp_path), "crashes"))) == 2
+        assert os.path.exists(os.path.join(d1, "log0"))
+        assert os.path.exists(os.path.join(d1, "log1"))
+        # /metrics carries the triage plane
+        text = mgr.metrics_text()
+        for series in ("syz_crash_clusters", "syz_triage_assigned_total",
+                       "syz_triage_dispatches_total",
+                       "syz_repro_rounds_total", "syz_repro_jobs_total"):
+            assert series in text, series
+        # crash trace records the cluster hop (lineage chain root)
+        traces = mgr.telemetry_snapshot()["traces"]
+        assert any(h["name"].startswith("triage:cluster")
+                   for t in traces for h in t["hops"])
+    finally:
+        mgr.stop()
+
+    # restart: gauges and dedup state rebuilt from workdir/crashes/
+    mgr2 = Manager(cfg)
+    try:
+        assert len(mgr2.crash_index) == 2
+        assert sum(mgr2.crash_types.values()) == 3
+        d4 = mgr2.save_crash(_outcome(
+            b"[ 4.0] BUG: KASAN: wild-memory-access on address dead0330\n"
+            b"[ 4.1] Write of size 32 by task d/4\n"))
+        assert d4 == d1                     # same cluster id across restart
+        assert os.path.exists(os.path.join(d4, "log2"))
+    finally:
+        mgr2.stop()
+
+
+# -- batched-bisection repro scheduler --------------------------------------
+
+
+def _crash_log(marker: bytes) -> bytes:
+    return (b"executing program 0:\n"
+            b"syz_probe$ints(0x1, 0x2, 0x3, 0x4, 0x5)\n"
+            b"executing program 1:\n"
+            b"syz_probe$ints(" + marker + b", 0x2, 0x3, 0x4, 0x5)\n"
+            b"syz_probe()\n"
+            b"[ 2.0] BUG: KASAN: use-after-free in foo+0x1/0x2\n")
+
+
+def test_scheduler_batches_many_crashes(table):
+    """N crashes bisect in ≤ ceil(total-candidates / workers) +
+    state-machine-depth rounds — NOT N × serial rounds: rounds pack
+    candidate tests from every active machine into one pool fan-out."""
+    N, W = 4, 8
+    markers = [b"0xdead%04x" % i for i in range(N)]
+
+    def crashes(data, opts, duration):
+        return any(m in data for m in markers)
+
+    done = {}
+    sched = ReproScheduler(
+        repro_pkg.Oracle(crashes, workers=W), table,
+        with_c_repro=False,
+        on_done=lambda t, d, r, j: done.__setitem__(t, (r, j)))
+    for i, m in enumerate(markers):
+        assert sched.submit(_crash_log(m), f"crash{i}", "")
+    # dedup: a second submit for an active title is refused
+    assert not sched.submit(_crash_log(markers[0]), "crash0", "")
+    assert sched.join(timeout=60)
+    assert len(done) == N
+    for title, (res, job) in done.items():
+        assert res is not None and res.prog is not None, title
+        assert len(res.prog.calls) == 1     # minimized to the crasher
+
+    # serial baseline: per-crash sequential predicate executions
+    serial = []
+    for m in markers:
+        count = [0]
+
+        def pred(data, opts, duration, count=count):
+            count[0] += 1
+            return crashes(data, opts, duration)
+
+        res = repro_pkg.run(_crash_log(m), table, pred,
+                            with_c_repro=False, quick=0.01, thorough=0.02)
+        assert res is not None and res.prog is not None
+        serial.append(count[0])
+
+    depth = max(serial)                     # deepest sequential chain
+    bound = math.ceil(sched.stat_tests / W) + depth
+    assert sched.stat_rounds <= bound, \
+        (sched.stat_rounds, bound, serial)
+    # and strictly better than the serial regime's N × depth rounds
+    assert sched.stat_rounds < sum(serial)
+    sched.stop()
+
+
+def test_scheduler_survives_broken_log(table):
+    """A log with no parseable program resolves as a failed job without
+    wedging the round loop."""
+    done = []
+    sched = ReproScheduler(
+        repro_pkg.Oracle(lambda *a: False, workers=2), table,
+        with_c_repro=False,
+        on_done=lambda t, d, r, j: done.append((t, r)))
+    assert sched.submit(b"no programs here\n", "empty", "")
+    assert sched.submit(_crash_log(b"0x1"), "nocrash", "")
+    assert sched.join(timeout=30)
+    sched.stop()
+    assert sorted(t for t, _ in done) == ["empty", "nocrash"]
+    assert all(r is None for _, r in done)
+
+
+def test_scheduler_records_lineage_trace(table):
+    from syzkaller_tpu.telemetry import Tracer
+
+    tracer = Tracer()
+    sched = ReproScheduler(
+        repro_pkg.Oracle(lambda data, o, d: b"0xdeadbeef" in data,
+                         workers=2),
+        table, with_c_repro=False, tracer=tracer)
+    assert sched.submit(_crash_log(b"0xdeadbeef"), "t", "",
+                        links=("crash-trace-id",))
+    assert sched.join(timeout=30)
+    sched.stop()
+    spans = tracer.snapshot()
+    assert spans and spans[-1]["links"] == ["crash-trace-id"]
+    names = [h["name"] for h in spans[-1]["hops"]]
+    assert any(n.startswith("repro:suspects") for n in names)
+    assert any(n.startswith("repro:minimize") for n in names)
+    assert any(n.startswith("repro:done") for n in names)
+
+
+def test_span_links_wire_roundtrip():
+    ctx = SpanContext(origin="m")
+    ctx.links = ["abc", "def"]
+    ctx.add_hop("x", 0.001)
+    back = SpanContext.from_wire(ctx.to_wire())
+    assert back is not None and back.links == ["abc", "def"]
+    # absent links stay absent on the wire (old peers see no new key)
+    assert "links" not in SpanContext(origin="m").to_wire()
