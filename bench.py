@@ -659,6 +659,72 @@ def bench_repro_rounds(smoke=False):
     }
 
 
+def bench_campaign(smoke=False):
+    """Campaign-plane costs: `campaign_swap_seconds` measures
+    invalidate→first steered block — the warm overlay-swap latency of
+    rotating the decision stream onto another campaign through the
+    epoch path — with a CompileCounter pin proving the rotate-through-
+    all-campaigns storm compiles NOTHING warm (overlay operands are
+    fixed (C,) shapes; only contents change).  Per-campaign
+    `new_cov_per_1k_exec` replays a synthetic steered frontier through
+    the fused admission gate (each campaign owns a disjoint PC
+    subrange) and reads the scheduler's EWMA — the rotation-trigger
+    gauge, exercised end to end."""
+    from syzkaller_tpu.campaign import (CampaignScheduler,
+                                        available_campaigns, load_campaign)
+    from syzkaller_tpu.cover.engine import CoverageEngine
+    from syzkaller_tpu.fuzzer.device_ct import DecisionStream
+    from syzkaller_tpu.sys.table import load_table
+    from syzkaller_tpu.vet.runtime import CompileCounter
+
+    table = load_table()
+    names = available_campaigns()
+    eng = CoverageEngine(npcs=1 << 13, ncalls=table.count,
+                         corpus_cap=2048)
+    camps = {n: load_campaign(n, table) for n in names}
+    ovs = {n: eng.make_overlay(n, camps[n].boost, camps[n].enabled_ids)
+           for n in names}
+    stream = DecisionStream(eng, per_row=16, hot_slots=64, corpus_rows=32,
+                            entropy_words=1024, autostart=False)
+    for n in names:                       # warm: one compile total
+        stream.set_overlay(ovs[n])
+        stream.refill_once()
+    times = []
+    with CompileCounter() as cc:
+        for _ in range(2 if smoke else 6):
+            for n in names:               # the rotation storm
+                t0 = time.perf_counter()
+                stream.set_overlay(ovs[n])
+                stream.refill_once()      # first steered block lands
+                times.append(time.perf_counter() - t0)
+
+    now = [0.0]
+    sched = CampaignScheduler(names, tau=30.0, now=lambda: now[0])
+    rng = np.random.default_rng(5)
+    per = {}
+    nb_batches = 4 if smoke else 16
+    for i, n in enumerate(names):
+        conn = f"vm{i}"
+        sched.assign(conn)                # round-robin = names order
+        base = 500 + i * 2500
+        for _ in range(nb_batches):
+            now[0] += 1.0
+            idx = rng.integers(base, base + 800, size=(8, 32)).astype(
+                np.int32)
+            cids = rng.integers(0, table.count, size=8).astype(np.int32)
+            _hn, _rows, nb = eng.admit_if_new(
+                cids, idx, np.ones_like(idx, bool), with_new_bits=True)
+            sched.note_execs(conn, 1000 // nb_batches)
+            sched.note_new_cov(conn, int(nb.sum()))
+        per[n] = round(sched.new_cov_per_1k_exec(n), 2)
+    return {
+        "campaign_swap_seconds": round(float(np.median(times)), 4),
+        "campaign_swap_recompiles": cc.count,
+        "new_cov_per_1k_exec": dict(
+            per, all=round(sched.new_cov_per_1k_exec(), 2)),
+    }
+
+
 def _stage(name):
     sys.stderr.write(f"[bench] {name}\n")
     sys.stderr.flush()
@@ -764,6 +830,8 @@ def main(argv=None):
                                smoke=args.smoke))
     _stage("repro scheduler")
     extras.update(bench_repro_rounds(smoke=args.smoke))
+    _stage("campaign plane")
+    extras.update(bench_campaign(smoke=args.smoke))
     # static-analysis gate trajectory: the BENCH_*.json series records
     # the vet finding counts alongside throughput, so a PR that buys
     # speed by parking P0s in the baseline shows up in the history

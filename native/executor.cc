@@ -481,8 +481,13 @@ static long syz_kvm_setup_cpu(uint64_t vmfd, uint64_t cpufd, uint64_t umem,
 {
 	// typed setup options {typ int64, val int64} (DSL kvm_setup_opt;
 	// ref sys/kvm.txt:181-205 option structs): 1=cr0 2=cr4 3=efer
-	// 4=rflags, OR'd into the mode's computed base state
+	// 4=rflags OR'd into the mode's computed base state; 5=tsc (guest
+	// TSC via MSR_IA32_TSC), 6=msr (val packs index<<32 | value32 to
+	// keep the 2-word wire layout), 7=seg (data-segment override:
+	// val packs selector | type<<16, applied to ds/es)
 	uint64_t opt_cr0 = 0, opt_cr4 = 0, opt_efer = 0, opt_rflags = 0;
+	uint64_t opt_tsc = 0, opt_msr = 0, opt_seg = 0;
+	int has_tsc = 0, has_msr = 0, has_seg = 0;
 	for (uint64_t i = 0; i < nopt && i < 8; i++) {
 		uint64_t typ = 0, val = 0;
 		NONFAILING(typ = ((uint64_t*)opts)[2 * i]);
@@ -492,6 +497,9 @@ static long syz_kvm_setup_cpu(uint64_t vmfd, uint64_t cpufd, uint64_t umem,
 		case 2: opt_cr4 |= val; break;
 		case 3: opt_efer |= val; break;
 		case 4: opt_rflags |= val; break;
+		case 5: opt_tsc = val; has_tsc = 1; break;
+		case 6: opt_msr = val; has_msr = 1; break;
+		case 7: opt_seg = val; has_seg = 1; break;
 		}
 	}
 	const uint64_t kGuestPages = 24;
@@ -585,8 +593,38 @@ static long syz_kvm_setup_cpu(uint64_t vmfd, uint64_t cpufd, uint64_t umem,
 	sregs.cr0 |= opt_cr0;
 	sregs.cr4 |= opt_cr4;
 	sregs.efer |= opt_efer;
+	if (has_seg) { // data-segment override on top of the flat base
+		uint16_t sel = opt_seg & 0xffff;
+		uint8_t styp = (opt_seg >> 16) & 0xf;
+		sregs.ds.selector = sregs.es.selector = sel;
+		if (styp)
+			sregs.ds.type = sregs.es.type = styp;
+	}
 	if (ioctl(cpufd, KVM_SET_SREGS, &sregs))
 		return -1;
+
+	if (has_tsc || has_msr) {
+		// best effort: a rejected MSR write must not fail the
+		// whole bring-up (fuzzed indices are often invalid)
+		struct {
+			struct kvm_msrs hdr;
+			struct kvm_msr_entry entries[2];
+		} msrs;
+		memset(&msrs, 0, sizeof(msrs));
+		int n = 0;
+		if (has_tsc) {
+			msrs.entries[n].index = 0x10; // MSR_IA32_TSC
+			msrs.entries[n].data = opt_tsc;
+			n++;
+		}
+		if (has_msr) {
+			msrs.entries[n].index = (uint32_t)(opt_msr >> 32);
+			msrs.entries[n].data = (uint32_t)opt_msr;
+			n++;
+		}
+		msrs.hdr.nmsrs = n;
+		ioctl(cpufd, KVM_SET_MSRS, &msrs);
+	}
 
 	struct kvm_regs regs;
 	memset(&regs, 0, sizeof(regs));

@@ -1,0 +1,28 @@
+"""Campaign plane: stateful subsystem fuzzing.
+
+A campaign is a declarative overlay — enabled call set + priority-
+matrix boost + optional protocol state machine + resource seed policy —
+that retargets the whole fuzzing plane at one subsystem without
+recompiles: the decision-stream megakernel consumes the overlay as two
+fixed-shape device operands, per-campaign coverage frontiers are
+word-block-sparse views over the shared device bitmap, and the manager
+rotates connections across campaigns when `new_cov_per_1k_exec` decays.
+
+Shipped campaigns (descriptions/campaigns/*.campaign):
+  vnet-tcp   — the typed vnet grammar as a protocol-state fuzzer
+               (TCP handshake/teardown against the tun subnet)
+  kvm-guest  — staged KVM guest bring-up (fd chain, segment/MSR/TSC
+               setup options, arm64 + ifuzz guest payloads)
+  fs-image   — mount-image mutation (mount/io/umount cycles)
+"""
+
+from syzkaller_tpu.campaign.campaign import Campaign, load_campaign  # noqa: F401
+from syzkaller_tpu.campaign.machine import (  # noqa: F401
+    ProtocolMachine, TransitionCoverage, Walk,
+)
+from syzkaller_tpu.campaign.scheduler import (  # noqa: F401
+    GLOBAL, CampaignScheduler,
+)
+from syzkaller_tpu.sys.campaigns import (  # noqa: F401
+    CampaignError, available_campaigns,
+)

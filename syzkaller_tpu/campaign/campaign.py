@@ -1,0 +1,128 @@
+"""Campaign runtime: the declarative overlay bound to a syscall table.
+
+`load_campaign(name, table)` parses + compiles a shipped campaign
+description (sys/campaigns.py) and wraps it with its runtime pieces:
+the protocol machine (when the description declares one), a
+transition-coverage view, and stateful program generation that follows
+the machine and the resource seed policy.
+
+The device side of a campaign — the (C,) boost/enabled overlay the
+decision megakernel consumes — is built by CoverageEngine.make_overlay
+from this object's `boost`/`enabled_ids`; host-side choice tables use
+`host_choice_table` for the same distribution without a device.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from syzkaller_tpu import prog as P
+from syzkaller_tpu.campaign.machine import ProtocolMachine, TransitionCoverage
+from syzkaller_tpu.prog import model as M
+from syzkaller_tpu.prog.analysis import State
+from syzkaller_tpu.prog.rand import Gen, Rand
+from syzkaller_tpu.sys import campaigns as C
+from syzkaller_tpu.sys.table import SyscallTable
+
+
+class Campaign:
+    """One compiled campaign bound to a syscall table."""
+
+    def __init__(self, compiled: C.CompiledCampaign, table: SyscallTable):
+        self.name = compiled.name
+        self.table = table
+        self.enabled_ids = list(compiled.enabled_ids)
+        self.boost = np.asarray(compiled.boost, np.float32)
+        self.seed_ids = list(compiled.seed_ids)
+        self.machine: "ProtocolMachine | None" = (
+            ProtocolMachine(compiled) if compiled.has_machine else None)
+
+    # -- host-side steering ------------------------------------------------
+
+    def restrict_enabled(self, enabled_ids) -> list[int]:
+        """The overlay's enabled set intersected with the fuzzer's own
+        (host-supported ∩ closure) set; falls back to the campaign set
+        when the intersection is empty (a host that supports nothing
+        the campaign wants should fuzz the campaign set rather than
+        silently reverting to flat soup)."""
+        inter = sorted(set(self.enabled_ids) & set(enabled_ids))
+        return inter or list(self.enabled_ids)
+
+    def host_choice_table(self, prios: np.ndarray,
+                          enabled_ids) -> P.ChoiceTable:
+        """The campaign distribution for the no-device path: boosted
+        priority columns, restricted enabled set — the same reweighting
+        the device overlay applies inside the megakernel."""
+        boosted = np.asarray(prios, np.float32) * self.boost[None, :]
+        return P.ChoiceTable(boosted,
+                             set(self.restrict_enabled(enabled_ids)),
+                             ncalls=self.table.count)
+
+    def transition_coverage(self) -> "TransitionCoverage | None":
+        return (TransitionCoverage(self.machine)
+                if self.machine is not None else None)
+
+    # -- stateful generation ----------------------------------------------
+
+    def generate(self, rand: Rand, ncalls: int = 30,
+                 choice_table=None, pid: int = 0) -> M.Prog:
+        """Protocol-aware generation: the resource seed prologue first
+        (the campaign's fd chain / device bring-up), then a walk of the
+        protocol machine — each step takes an enabled transition from
+        the current state, so generated programs are handshake-ordered
+        sequences instead of uncorrelated call soup.  Campaigns without
+        a machine get the seed prologue + choice-table growth."""
+        p = M.Prog()
+        state = State(self.table)
+        gen = Gen(rand, state, self.table, choice_table, pid)
+        for cid in self.seed_ids:
+            if len(p.calls) >= ncalls:
+                break
+            try:
+                p.calls.extend(
+                    gen.generate_particular_call(self.table.calls[cid]))
+            except Exception:
+                continue
+        if self.machine is None:
+            while len(p.calls) < ncalls and not rand.one_of(3):
+                prev = p.calls[-1].meta.id if p.calls else -1
+                p.calls.extend(gen.generate_call(prev))
+            if not p.calls:
+                p.calls.extend(gen.generate_call(-1))
+            return p
+        st = self.machine.walk(p.calls).final_state
+        steps = 2 + rand.intn(max(self.machine.n_transitions, 2))
+        for _ in range(steps):
+            if len(p.calls) >= ncalls:
+                break
+            nexts = self.machine.enabled_transitions(st)
+            if not nexts:
+                st = self.machine.initial
+                nexts = self.machine.enabled_transitions(st)
+                if not nexts:
+                    break
+            t = nexts[rand.intn(len(nexts))]
+            try:
+                p.calls.extend(self.machine.build_call(gen, t))
+            except Exception:
+                continue
+            st = t.dst
+        if not p.calls:
+            p.calls.extend(gen.generate_call(-1))
+        return p
+
+    def mutate(self, p: M.Prog, rand: Rand, ncalls: int = 30,
+               choice_table=None, corpus=None, pid: int = 0) -> None:
+        """Protocol-respecting mutation when the campaign has a
+        machine; the flat mutator otherwise."""
+        if self.machine is not None:
+            P.mutate_sequence(p, rand, self.table, self.machine,
+                              ncalls, choice_table, pid)
+        else:
+            P.mutate(p, rand, self.table, ncalls, choice_table,
+                     corpus, pid)
+
+
+def load_campaign(name: str, table: SyscallTable,
+                  desc_dir: "str | None" = None) -> Campaign:
+    return Campaign(C.load_compiled(name, table, desc_dir), table)

@@ -1,0 +1,208 @@
+"""Protocol state machines + transition coverage for campaigns.
+
+A `ProtocolMachine` is the runtime of a campaign description's
+state/transition block: it classifies generated calls into protocol
+transitions (call-name match + flag-word match inside the argument
+tree), walks programs to their final protocol state, and builds calls
+that TAKE a chosen transition (generate the syscall, then force the
+transition's flag word into the right flags-typed const argument — the
+vnet grammar's TCP doff/flags word, kvm setup modes, mount flags).
+
+Transition coverage is tracked in a word-block-sparse view
+(cover.engine.SparseView) whose bit universe is the dense transition-id
+space — the same mechanics as the per-campaign device frontiers, so the
+campaign plane has ONE notion of "new ground reached" whether the
+ground is kernel PCs or protocol transitions.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+from syzkaller_tpu.prog import model as M
+from syzkaller_tpu.sys import types as T
+from syzkaller_tpu.sys.campaigns import CompiledCampaign, CompiledTransition
+
+
+@dataclass
+class Walk:
+    """Result of replaying a program through the machine."""
+    final_state: str
+    transitions: list[int] = field(default_factory=list)  # tids in order
+    states: list[str] = field(default_factory=list)       # visited states
+
+
+def _call_flag_values(c: M.Call, flag: int) -> bool:
+    """True iff the call carries `flag` in a flags-typed const argument
+    (the word must be a member of the flags set — a random int that
+    happens to equal the value does not count as taking the
+    transition)."""
+    found = []
+
+    def visit(a: M.Arg, _p):
+        if (isinstance(a, M.ConstArg) and isinstance(a.typ, T.FlagsType)
+                and flag in a.typ.vals and a.val == flag):
+            found.append(a)
+
+    M.foreach_arg(c, visit)
+    return bool(found)
+
+
+def _type_flag_slots(t: T.Type, flag: int, depth: int = 0) -> bool:
+    """Does the type subtree contain a FlagsType whose value set
+    includes `flag`?  (Used to steer union regeneration toward the
+    option that can carry the transition's flag word.)"""
+    if depth > 12:
+        return False
+    if isinstance(t, T.FlagsType):
+        return flag in t.vals
+    if isinstance(t, T.PtrType):
+        return t.elem is not None and _type_flag_slots(t.elem, flag,
+                                                      depth + 1)
+    if isinstance(t, T.ArrayType):
+        return _type_flag_slots(t.elem, flag, depth + 1)
+    if isinstance(t, T.StructType):
+        return any(_type_flag_slots(f, flag, depth + 1) for f in t.fields)
+    if isinstance(t, T.UnionType):
+        return any(_type_flag_slots(o, flag, depth + 1) for o in t.options)
+    return False
+
+
+class ProtocolMachine:
+    """Runtime protocol machine for one campaign."""
+
+    def __init__(self, campaign: CompiledCampaign):
+        if not campaign.has_machine:
+            raise ValueError(f"campaign {campaign.name} has no machine")
+        self.name = campaign.name
+        self.states = list(campaign.states)
+        self.initial = campaign.initial
+        self.transitions = list(campaign.transitions)
+        self._by_src: dict[str, list[CompiledTransition]] = {}
+        for t in self.transitions:
+            self._by_src.setdefault(t.src, []).append(t)
+
+    @property
+    def n_transitions(self) -> int:
+        return len(self.transitions)
+
+    def enabled_transitions(self, state: str) -> list[CompiledTransition]:
+        return self._by_src.get(state, [])
+
+    def classify(self, state: str, c: M.Call
+                 ) -> "CompiledTransition | None":
+        """The transition this call takes from `state`, or None (a call
+        that matches no transition leaves the protocol state alone —
+        interleaved unrelated calls don't reset a handshake)."""
+        for t in self._by_src.get(state, []):
+            if c.meta.id not in t.call_ids:
+                continue
+            if t.flag is None or _call_flag_values(c, t.flag):
+                return t
+        return None
+
+    def walk(self, calls: "list[M.Call]") -> Walk:
+        """Replay a program: the state trajectory and the transition
+        ids it takes, in order."""
+        st = self.initial
+        w = Walk(final_state=st, states=[st])
+        for c in calls:
+            t = self.classify(st, c)
+            if t is None:
+                continue
+            st = t.dst
+            w.transitions.append(t.tid)
+            w.states.append(st)
+        w.final_state = st
+        return w
+
+    # -- call construction -------------------------------------------------
+
+    def build_call(self, gen, t: CompiledTransition) -> "list[M.Call]":
+        """Generate a call that takes transition `t`: pick one of its
+        syscalls, generate it (plus resource prerequisites), and force
+        the transition's flag word into a flags-typed const slot —
+        regenerating the union option that carries the slot when the
+        generator picked one that can't (the vnet l4 payload choosing
+        udp when the transition needs a TCP flags word)."""
+        ids = sorted(t.call_ids)
+        meta = gen.table.calls[ids[gen.r.intn(len(ids))]]
+        calls = gen.generate_particular_call(meta)
+        c = calls[-1]
+        if t.flag is not None:
+            self._force_flag(gen, c, t.flag)
+        return calls
+
+    def _force_flag(self, gen, c: M.Call, flag: int) -> None:
+        from syzkaller_tpu.prog import analysis
+
+        if self._set_flag_arg(c, flag):
+            analysis.assign_sizes_call(c)
+            return
+        # no live slot: re-pick union options toward one that has it
+        retargeted = []
+
+        def visit(a: M.Arg, _p):
+            if retargeted or not isinstance(a, M.UnionArg):
+                return
+            ut = a.typ
+            if not isinstance(ut, T.UnionType):
+                return
+            if _type_flag_slots(a.option_typ, flag):
+                return          # current option already carries a slot
+            for opt in ut.options:
+                if _type_flag_slots(opt, flag):
+                    na, _extra = gen.generate_arg(opt)
+                    M.replace_arg(c, a, M.UnionArg(ut, na, opt))
+                    retargeted.append(opt)
+                    return
+
+        M.foreach_arg(c, visit)
+        if retargeted:
+            self._set_flag_arg(c, flag)
+        analysis.assign_sizes_call(c)
+
+    @staticmethod
+    def _set_flag_arg(c: M.Call, flag: int) -> bool:
+        hit = []
+
+        def visit(a: M.Arg, _p):
+            if (not hit and isinstance(a, M.ConstArg)
+                    and isinstance(a.typ, T.FlagsType)
+                    and flag in a.typ.vals):
+                a.val = flag
+                hit.append(a)
+
+        M.foreach_arg(c, visit)
+        return bool(hit)
+
+
+class TransitionCoverage:
+    """Per-campaign transition-coverage bitmap: a word-block-sparse
+    view whose bit universe is the machine's dense transition ids."""
+
+    def __init__(self, machine: ProtocolMachine, block_words: int = 2):
+        from syzkaller_tpu.cover.engine import SparseView, nwords_for
+
+        self.machine = machine
+        self.view = SparseView(
+            f"transitions:{machine.name}", ncalls=1,
+            nwords=nwords_for(max(machine.n_transitions, 1)),
+            block_words=block_words)
+
+    def observe(self, calls: "list[M.Call]") -> Walk:
+        """Walk a program and mark the transitions it takes."""
+        w = self.machine.walk(calls)
+        if w.transitions:
+            self.view.mark(w.transitions)
+        return w
+
+    def covered(self) -> "set[int]":
+        import numpy as np
+
+        row = self.view.to_dense()[0]
+        bits = np.unpackbits(row.view(np.uint8), bitorder="little")
+        return set(np.nonzero(bits)[0].tolist())
+
+    def popcount(self) -> int:
+        return self.view.popcount()

@@ -1,0 +1,225 @@
+"""Manager-side campaign scheduler.
+
+Assigns campaigns to fuzzer connections (round-robin over the
+configured set), tracks per-campaign frontier productivity as the
+`syz_new_cov_per_1k_exec` EWMA — new coverage bits admitted per 1000
+executions, the rotation trigger ROADMAP's autopilot item names — and
+rotates a connection to the next campaign when its campaign's rate
+decays below the configured threshold.  Per-campaign corpus tags
+(which admitted programs each campaign discovered) persist to
+workdir/campaigns.json so a restarted manager keeps attribution.
+
+Lock discipline: `_mu` guards assignment/counter state only; EWMA
+reads, gauge callbacks and the tags-file write all run outside it
+(the file write stages the payload under the lock and flushes after
+release, hub/state.py-style).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+
+from syzkaller_tpu.telemetry.registry import EwmaRate
+from syzkaller_tpu.utils import log
+
+# the fleet-wide (all-campaigns + flat) pseudo-label
+GLOBAL = "all"
+
+
+class _Rates:
+    """One campaign's EWMA pair: execs/sec and new-cov-bits/sec; the
+    exported value is their ratio per 1000 execs.  Both decay toward
+    zero during silence, so the ratio of a stalled campaign reads from
+    its most recent activity instead of freezing forever."""
+
+    def __init__(self, tau: float):
+        self.execs = EwmaRate("execs", tau=tau)
+        self.cov = EwmaRate("cov", tau=tau)
+        self.exec_total = 0
+        self.cov_total = 0
+
+    def per_1k(self, now: "float | None" = None) -> float:
+        e = self.execs.rate(now)
+        if e <= 0.0:
+            return 0.0
+        return 1000.0 * self.cov.rate(now) / e
+
+
+class CampaignScheduler:
+    """Round-robin assignment + decay-triggered rotation."""
+
+    def __init__(self, campaigns: "list[str]", rotation: float = 0.0,
+                 min_execs: int = 2000, tau: float = 120.0,
+                 registry=None, now=None):
+        self.campaigns = list(campaigns)
+        self.rotation = float(rotation)
+        self.min_execs = int(min_execs)
+        self._now = now or time.monotonic
+        self._mu = threading.Lock()
+        self._next = 0
+        self._assigned: dict[str, str] = {}      # conn name -> campaign
+        self._rates: dict[str, _Rates] = {GLOBAL: _Rates(tau)}
+        for c in self.campaigns:
+            self._rates[c] = _Rates(tau)
+        self._tau = tau
+        self._tags: dict[str, list[str]] = {c: [] for c in self.campaigns}
+        self._tags_dirty = False
+        self.stat_rotations = 0
+        self._c_rotations = None
+        if registry is not None:
+            self._register(registry)
+
+    def _register(self, registry) -> None:
+        fam = registry.gauge(
+            "syz_new_cov_per_1k_exec",
+            "new coverage bits admitted per 1000 execs (EWMA; the "
+            "campaign-rotation trigger)", labels=("campaign",))
+        for name in [GLOBAL] + self.campaigns:
+            g = fam.labels(campaign=name)
+            g.set_function(lambda n=name: self.new_cov_per_1k_exec(n))
+        self._c_rotations = registry.counter(
+            "syz_campaign_rotations_total",
+            "connections rotated off a decayed campaign")
+
+    # -- assignment --------------------------------------------------------
+
+    def assign(self, conn: str) -> "str | None":
+        """The campaign for a (re)connecting fuzzer; None when no
+        campaigns are configured (flat mode)."""
+        if not self.campaigns:
+            return None
+        with self._mu:
+            cur = self._assigned.get(conn)
+            if cur is not None:
+                return cur
+            c = self.campaigns[self._next % len(self.campaigns)]
+            self._next += 1
+            self._assigned[conn] = c
+            return c
+
+    def current(self, conn: str) -> "str | None":
+        with self._mu:
+            return self._assigned.get(conn)
+
+    def drop(self, conn: str) -> None:
+        with self._mu:
+            self._assigned.pop(conn, None)
+
+    # -- accounting --------------------------------------------------------
+
+    def note_execs(self, conn: "str | None", n: int) -> None:
+        if n <= 0:
+            return
+        now = self._now()
+        with self._mu:
+            camp = self._assigned.get(conn) if conn else None
+            rs = [self._rates[GLOBAL]]
+            if camp is not None and camp in self._rates:
+                rs.append(self._rates[camp])
+            for r in rs:
+                r.exec_total += n
+                r.execs.add(n, now=now)
+
+    def note_new_cov(self, conn: "str | None", bits: int,
+                     sig_hex: "str | None" = None) -> None:
+        """Record admitted new-coverage bits (and optionally tag the
+        admitted program's sig for per-campaign corpus attribution)."""
+        if bits <= 0:
+            return
+        now = self._now()
+        with self._mu:
+            camp = self._assigned.get(conn) if conn else None
+            rs = [self._rates[GLOBAL]]
+            if camp is not None and camp in self._rates:
+                rs.append(self._rates[camp])
+                if sig_hex:
+                    self._tags[camp].append(sig_hex)
+                    self._tags_dirty = True
+            for r in rs:
+                r.cov_total += bits
+                r.cov.add(bits, now=now)
+
+    def new_cov_per_1k_exec(self, campaign: str = GLOBAL) -> float:
+        with self._mu:
+            r = self._rates.get(campaign)
+        return r.per_1k(self._now()) if r is not None else 0.0
+
+    # -- rotation ----------------------------------------------------------
+
+    def maybe_rotate(self, conn: str) -> "str | None":
+        """Rotate `conn` to the next campaign when its current one has
+        decayed: enough execs observed AND new_cov_per_1k_exec below
+        the threshold.  Returns the new assignment (None = unchanged).
+        Called per Poll — cheap (two EWMA reads)."""
+        if not self.campaigns or self.rotation <= 0.0 \
+                or len(self.campaigns) < 2:
+            return None
+        now = self._now()
+        with self._mu:
+            camp = self._assigned.get(conn)
+            if camp is None:
+                return None
+            r = self._rates.get(camp)
+            if r is None or r.exec_total < self.min_execs:
+                return None
+            if r.per_1k(now) >= self.rotation:
+                return None
+            i = self.campaigns.index(camp)
+            nxt = self.campaigns[(i + 1) % len(self.campaigns)]
+            self._assigned[conn] = nxt
+            # fresh productivity window for the incoming campaign on
+            # this connection: its own EWMA keeps history, but the
+            # exec floor re-arms so a one-poll-old campaign isn't
+            # immediately rotated again
+            self._rates[nxt].exec_total = min(
+                self._rates[nxt].exec_total, self.min_execs // 2)
+            self.stat_rotations += 1
+        if self._c_rotations is not None:
+            self._c_rotations.inc()
+        log.logf(0, "campaign rotation: %s %s -> %s "
+                 "(new_cov_per_1k_exec decayed below %.3g)",
+                 conn, camp, nxt, self.rotation)
+        return nxt
+
+    # -- persistence -------------------------------------------------------
+
+    def persist(self, workdir: str) -> None:
+        """Write per-campaign corpus tags to workdir/campaigns.json
+        (atomic tmp+rename; payload staged under the lock, file I/O
+        outside it)."""
+        with self._mu:
+            if not self._tags_dirty:
+                return
+            payload = json.dumps(
+                {"tags": {c: list(v) for c, v in self._tags.items()},
+                 "rotations": self.stat_rotations},
+                indent=1, sort_keys=True)
+            self._tags_dirty = False
+        path = os.path.join(workdir, "campaigns.json")
+        tmp = path + ".tmp"
+        try:
+            with open(tmp, "w") as f:
+                f.write(payload)
+            os.replace(tmp, path)
+        except OSError as e:
+            log.logf(1, "campaign tags persistence failed: %s", e)
+
+    def restore(self, workdir: str) -> None:
+        path = os.path.join(workdir, "campaigns.json")
+        try:
+            with open(path) as f:
+                data = json.load(f)
+        except (OSError, ValueError):
+            return
+        tags = data.get("tags", {})
+        with self._mu:
+            for c, sigs in tags.items():
+                if c in self._tags:
+                    self._tags[c] = list(sigs)
+
+    def tags(self, campaign: str) -> "list[str]":
+        with self._mu:
+            return list(self._tags.get(campaign, []))

@@ -372,17 +372,31 @@ def sample_calls(key: jax.Array, probs: jax.Array, prev: jax.Array,
                  enabled: jax.Array) -> jax.Array:
     """Batched ChoiceTable draw: (B,) prev call ids (-1 = no context) →
     (B,) next call ids ~ probs[prev] restricted to enabled calls.
+    The flat (overlay-free) draw: a neutral all-ones boost."""
+    return sample_calls_boosted(key, probs, prev, enabled,
+                                jnp.ones((probs.shape[0],), probs.dtype))
+
+
+def sample_calls_boosted(key: jax.Array, probs: jax.Array, prev: jax.Array,
+                         enabled: jax.Array,
+                         boost: jax.Array) -> jax.Array:
+    """`sample_calls` with a campaign-overlay column multiplier.
 
     Prefix-CDF formulation — exactly the reference's Choose (one draw
     into the prefix-sum row, prog/prio.go:230-249) vectorized: ONE
     uniform per draw and a compare-and-sum instead of a Gumbel trick
     that needs B×C random bits (RNG generation measures ~160M u32/s on
-    this backend, so the Gumbel path was RNG-bound)."""
+    this backend, so the Gumbel path was RNG-bound).
+
+    `boost` is the overlay's (C,) float32 column multiplier: it
+    reweights every context row INCLUDING the no-context uniform row,
+    so a steered stream biases generation even before a prev context
+    exists.  All-ones reproduces the flat draw bit-for-bit."""
     C = probs.shape[0]
     rows = jnp.where(prev[:, None] >= 0,
                      probs[jnp.clip(prev, 0, C - 1)],
                      jnp.ones((1, C), probs.dtype))
-    w = jnp.where(enabled[None, :], rows, 0.0)
+    w = jnp.where(enabled[None, :], rows, 0.0) * boost[None, :]
     cdf = jnp.cumsum(w, axis=1)
     u = jax.random.uniform(key, (prev.shape[0],)) * cdf[:, -1]
     # index of the first cdf entry > u; interior zero-weight (disabled)
@@ -396,6 +410,15 @@ def sample_calls(key: jax.Array, probs: jax.Array, prev: jax.Array,
 
 def sample_calls_rows(key: jax.Array, probs: jax.Array, enabled: jax.Array,
                       per_row: int) -> jax.Array:
+    """All-contexts draw with the neutral (flat) boost."""
+    return sample_calls_rows_boosted(
+        key, probs, enabled, per_row,
+        jnp.ones((probs.shape[0],), probs.dtype))
+
+
+def sample_calls_rows_boosted(key: jax.Array, probs: jax.Array,
+                              enabled: jax.Array, per_row: int,
+                              boost: jax.Array) -> jax.Array:
     """All-contexts ChoiceTable draw: per_row samples for EVERY previous-
     call context in one shot — row 0 is the no-context (-1) row, row r+1
     conditions on prev call r.  Returns (C+1, per_row) int32 draws.
@@ -411,7 +434,7 @@ def sample_calls_rows(key: jax.Array, probs: jax.Array, enabled: jax.Array,
     the row total."""
     C = probs.shape[0]
     rows = jnp.concatenate([jnp.ones((1, C), probs.dtype), probs], axis=0)
-    w = jnp.where(enabled[None, :], rows, 0.0)
+    w = jnp.where(enabled[None, :], rows, 0.0) * boost[None, :]
     cdf = jnp.cumsum(w, axis=1)
     u = jax.random.uniform(key, (C + 1, per_row)) * cdf[:, -1:]
     idx = jax.vmap(
@@ -459,6 +482,132 @@ def _combine_words(bits) -> np.ndarray:
 def random_words(key: jax.Array, n: int) -> np.ndarray:
     """One device call → n uint64 words for prog.rand.Rand.refill."""
     return _combine_words(jax.random.bits(key, (2, n), dtype=jnp.uint32))
+
+
+# ---------------------------------------------------------------------------
+# Campaign overlays + word-block-sparse frontier views.
+
+
+@dataclass(frozen=True)
+class DeviceOverlay:
+    """A campaign's steering operands, device-resident and fixed-shape:
+    a (C,) float32 priority-column multiplier and a (C,) bool enabled
+    restriction.  Shapes never vary (always the full call axis), so
+    swapping one overlay for another changes operand CONTENTS only — a
+    warm decision megakernel never recompiles across campaign swaps."""
+    name: str
+    boost: jax.Array            # (C,) float32, device-resident
+    enabled: jax.Array          # (C,) bool, device-resident
+
+
+class SparseView:
+    """Word-block-sparse accumulation view over the shared coverage
+    bitmap: one campaign's frontier, stored as {block id -> (ncalls,
+    block_words) uint32} so N concurrent steered frontiers share one
+    device bitmap while each tracks only the blocks ITS execs lit up.
+    Absorbs the per-batch new-signal diffs the update dispatches
+    already compute (no extra device work); `merge`d views reproduce
+    the global bitmap exactly (every new bit is attributed to exactly
+    one batch by diff_merge's sequencing).
+
+    Host-side and lock-free of device work: callers absorb OUTSIDE the
+    engine's state lock (the diff arrays are plain fetch targets)."""
+
+    def __init__(self, tag: str, ncalls: int, nwords: int,
+                 block_words: int):
+        self.tag = tag
+        self.ncalls = ncalls
+        self.W = nwords
+        self.block_words = max(1, block_words)
+        self._blocks: dict[int, np.ndarray] = {}
+        self._mu = threading.Lock()
+
+    def _block(self, b: int) -> np.ndarray:
+        blk = self._blocks.get(b)
+        if blk is None:
+            blk = self._blocks[b] = np.zeros(
+                (self.ncalls, self.block_words), np.uint32)
+        return blk
+
+    def absorb(self, call_ids, result) -> None:
+        """Fold one update result's new-signal bits in.  Accepts an
+        UpdateResult (dense full-width diffs) or a SparseUpdateResult
+        (block-local diffs + touched-block list; its dense fallback has
+        blocks=None and full-width diffs)."""
+        new = np.asarray(result.new_bits)
+        blocks = getattr(result, "blocks", None)
+        call_ids = np.asarray(call_ids, np.int64)
+        bw = self.block_words
+        with self._mu:
+            if blocks is None:
+                nb = self.W // bw
+                for i, cid in enumerate(call_ids):
+                    row = new[i]
+                    for b in np.nonzero(
+                            row.reshape(nb, bw).any(axis=1))[0]:
+                        self._block(int(b))[cid] |= \
+                            row[b * bw: (b + 1) * bw]
+            else:
+                nb = self.W // bw
+                for i, cid in enumerate(call_ids):
+                    row = new[i]
+                    for k, b in enumerate(blocks):
+                        if b >= nb:
+                            continue            # sentinel padding
+                        seg = row[k * bw: (k + 1) * bw]
+                        if seg.any():
+                            self._block(int(b))[cid] |= seg
+
+    def mark(self, indices, call_id: int = 0) -> None:
+        """Set bits by global bitmap index (the transition-coverage
+        use: indices are dense transition ids)."""
+        idx = np.asarray(indices, np.int64).ravel()
+        idx = idx[(idx >= 0) & (idx < self.W * 32)]
+        with self._mu:
+            for x in idx:
+                b = int(x) >> 5
+                self._block(b // self.block_words)[
+                    call_id, b % self.block_words] |= \
+                    np.uint32(1) << np.uint32(x & 31)
+
+    def to_dense(self) -> np.ndarray:
+        """(ncalls, W) uint32 — the view materialized full-width."""
+        out = np.zeros((self.ncalls, self.W), np.uint32)
+        bw = self.block_words
+        with self._mu:
+            for b, blk in self._blocks.items():
+                out[:, b * bw: (b + 1) * bw] |= blk
+        return out
+
+    def popcount(self) -> int:
+        with self._mu:
+            if not self._blocks:
+                return 0
+            stack = np.stack(list(self._blocks.values()))
+        return int(np.unpackbits(stack.view(np.uint8)).sum())
+
+    def touched_block_count(self) -> int:
+        with self._mu:
+            return len(self._blocks)
+
+    def merge(self, other: "SparseView") -> None:
+        with other._mu:
+            items = [(b, blk.copy()) for b, blk in other._blocks.items()]
+        with self._mu:
+            for b, blk in items:
+                self._block(b)[:] |= blk
+
+
+def merge_views(views) -> np.ndarray:
+    """OR-union of several views' dense bitmaps (the 'frontiers merge
+    back to the global bitmap' acceptance check)."""
+    views = list(views)
+    if not views:
+        raise ValueError("no views")
+    out = views[0].to_dense()
+    for v in views[1:]:
+        out |= v.to_dense()
+    return out
 
 
 # ---------------------------------------------------------------------------
@@ -564,6 +713,15 @@ class CoverageEngine:
         # dummy stat-vector operands for the telemetry-disabled mode:
         # the jitted steps keep one signature either way
         self._ts_dummy = jnp.zeros((1,), jnp.int32)
+        # the flat (no-campaign) overlay: all-ones boost + all-true
+        # enabled restriction.  Campaign overlays share these shapes,
+        # so a swap changes operand contents only — never a signature.
+        self._ov_neutral = DeviceOverlay(
+            name="", boost=jnp.ones((ncalls,), jnp.float32),
+            enabled=jnp.ones((ncalls,), jnp.bool_))
+        # per-campaign frontier views over the shared bitmap
+        self._frontiers: dict[str, SparseView] = {}
+        self._frontier_mu = threading.Lock()
 
         if mesh is not None:
             self.shard(mesh)
@@ -586,6 +744,10 @@ class CoverageEngine:
         self.prios = jax.device_put(self.prios, rep)
         self.enabled = jax.device_put(self.enabled, rep)
         self._ts_dummy = jax.device_put(self._ts_dummy, rep)
+        self._ov_neutral = DeviceOverlay(
+            name="",
+            boost=jax.device_put(self._ov_neutral.boost, rep),
+            enabled=jax.device_put(self._ov_neutral.enabled, rep))
         if self.tstats is not None:
             self.tstats.device_put(mesh)
         self._build()
@@ -650,6 +812,11 @@ class CoverageEngine:
             bitmaps = pack_pcs(pc_idx, valid, npcs, assume_unique=True)
             gate = jnp.bitwise_or(corpus_cover, flakes)
             _g, _new, has_new = diff_merge(gate, call_ids, bitmaps)
+            # per-input new-bit counts (submission order): the frontier
+            # productivity signal the campaign scheduler's
+            # new_cov_per_1k_exec EWMA folds — free here, the diff rows
+            # are already materialized
+            rowbits = popcount_rows(_new)
             rows = jnp.where(has_new[:, None], bitmaps, jnp.uint32(0))
             cover = scatter_or(corpus_cover, call_ids, rows)
             idx = jnp.cumsum(has_new.astype(jnp.int32)) - 1 + start
@@ -658,7 +825,7 @@ class CoverageEngine:
             if ds is not None:
                 svec = _bump(svec, hinc, "admit_batches", "admit_inputs",
                              "admit_admitted", valid, has_new)
-            return cover, mat, has_new, svec
+            return cover, mat, has_new, rowbits, svec
 
         @functools.partial(jax.jit, donate_argnums=(0, 1))
         def _admit_if_new_choices(corpus_cover, corpus_mat, flakes,
@@ -672,6 +839,7 @@ class CoverageEngine:
             bitmaps = pack_pcs(pc_idx, valid, npcs, assume_unique=True)
             gate = jnp.bitwise_or(corpus_cover, flakes)
             _g, _new, has_new = diff_merge(gate, call_ids, bitmaps)
+            rowbits = popcount_rows(_new)
             rows = jnp.where(has_new[:, None], bitmaps, jnp.uint32(0))
             cover = scatter_or(corpus_cover, call_ids, rows)
             idx = jnp.cumsum(has_new.astype(jnp.int32)) - 1 + start
@@ -682,7 +850,7 @@ class CoverageEngine:
                 svec = _bump(svec, hinc, "admit_batches", "admit_inputs",
                              "admit_admitted", valid, has_new,
                              extra=[("admit_draws", prev.shape[0])])
-            return cover, mat, has_new, draws, svec
+            return cover, mat, has_new, rowbits, draws, svec
 
         @jax.jit
         def _diff_vs(base, call_ids, pc_idx, valid, flakes):
@@ -744,8 +912,10 @@ class CoverageEngine:
             return new_mat, cover
 
         @jax.jit
-        def _sample(key, probs, prev, enabled):
-            return sample_calls(key, probs, prev, enabled)
+        def _sample(key, probs, prev, enabled, ov_boost, ov_enabled):
+            return sample_calls_boosted(
+                key, probs, prev, jnp.logical_and(enabled, ov_enabled),
+                ov_boost)
 
         @jax.jit
         def _prio_update(static_prios, call_matrix):
@@ -759,9 +929,10 @@ class CoverageEngine:
         ncalls = self.ncalls
 
         @functools.partial(jax.jit, donate_argnums=(0,),
-                           static_argnums=(7, 8, 9))
-        def _decision(key, prios, enabled, corpus_mat, hot_prev, svec,
-                      hinc, per_row, n_rows, n_entropy):
+                           static_argnums=(9, 10, 11))
+        def _decision(key, prios, enabled, corpus_mat, hot_prev,
+                      ov_boost, ov_enabled, svec, hinc,
+                      per_row, n_rows, n_entropy):
             """The decision-stream megakernel: ONE dispatch emits a
             structured decision block — per-context choice-table draws
             for every prev row (cdf materialized once, draws are
@@ -771,10 +942,17 @@ class CoverageEngine:
             The PRNG key is donated: steady-state refills move no host
             operands in (prios/enabled/corpus_mat/hot_prev are already
             device-resident) and the ring-refill stats are bumped in
-            place on the device stat vector."""
+            place on the device stat vector.
+
+            `ov_boost`/`ov_enabled` are the campaign overlay: fixed
+            (C,) shapes (the neutral overlay is ones/trues), applied
+            INSIDE the dispatch so retargeting the stream at a
+            subsystem swaps operand contents, never the kernel."""
             key, k1, k2, k3, k4 = jax.random.split(key, 5)
-            base = sample_calls_rows(k1, prios, enabled, per_row)
-            hot = sample_calls(k2, prios, hot_prev, enabled)
+            en = jnp.logical_and(enabled, ov_enabled)
+            base = sample_calls_rows_boosted(k1, prios, en, per_row,
+                                             ov_boost)
+            hot = sample_calls_boosted(k2, prios, hot_prev, en, ov_boost)
             wts = popcount_rows(corpus_mat)
             logits = jnp.where(wts > 0,
                                jnp.log(wts.astype(jnp.float32)), -jnp.inf)
@@ -1038,8 +1216,8 @@ class CoverageEngine:
                              jnp.asarray(valid, jnp.bool_))
 
     @_locked
-    def admit_if_new(self, call_ids, pc_idx, valid
-                     ) -> "tuple[np.ndarray, np.ndarray | None]":
+    def admit_if_new(self, call_ids, pc_idx, valid,
+                     with_new_bits: bool = False):
         """Admission gate + corpus merge in one fused dispatch: per-entry
         new-vs-(corpus cover ∪ flakes) verdicts; entries with new signal
         merge into corpus cover and append matrix rows.  Returns
@@ -1047,20 +1225,29 @@ class CoverageEngine:
         in submission order) — rows is None when the matrix is full, in
         which case NOTHING merges (manager drop-the-input semantics).
         The capacity check is conservative — the whole batch must fit,
-        since the admitted count is only known after the dispatch."""
-        has_new, rows, _ch = self._admit_locked(call_ids, pc_idx, valid,
-                                                None)
+        since the admitted count is only known after the dispatch.
+        With with_new_bits=True a third element is returned: (B,) int32
+        per-input new-bit counts (submission order) — the frontier
+        productivity signal behind syz_new_cov_per_1k_exec."""
+        has_new, rows, _ch, nbits = self._admit_locked(
+            call_ids, pc_idx, valid, None)
+        if with_new_bits:
+            return has_new, rows, nbits
         return has_new, rows
 
     @_locked
-    def admit_batch(self, call_ids, pc_idx, valid, choice_prev
-                    ) -> "tuple[np.ndarray, np.ndarray | None, np.ndarray]":
+    def admit_batch(self, call_ids, pc_idx, valid, choice_prev,
+                    with_new_bits: bool = False):
         """admit_if_new fused with a batch of ChoiceTable draws in the
         SAME device dispatch (the coalescer's step): returns (has_new,
         rows, choices) where choices is (len(choice_prev),) next-call
-        ids drawn from the priority matrix."""
-        return self._admit_locked(call_ids, pc_idx, valid,
-                                  np.asarray(choice_prev, np.int32))
+        ids drawn from the priority matrix; with_new_bits appends the
+        (B,) per-input new-bit counts."""
+        has_new, rows, choices, nbits = self._admit_locked(
+            call_ids, pc_idx, valid, np.asarray(choice_prev, np.int32))
+        if with_new_bits:
+            return has_new, rows, choices, nbits
+        return has_new, rows, choices
 
     def _admit_locked(self, call_ids, pc_idx, valid, choice_prev):
         call_ids, pc_idx, valid = self._fit(call_ids, pc_idx, valid)
@@ -1070,22 +1257,23 @@ class CoverageEngine:
                 self.corpus_cover, call_ids, pc_idx, valid, self.flakes)
             choices = (self.sample_next_calls(choice_prev)
                        if choice_prev is not None else None)
-            return np.asarray(has_new), None, choices
+            return (np.asarray(has_new), None, choices,
+                    np.asarray(self._popcount_fn(new)))
         svec, hinc = self._ts_in()
         if choice_prev is None:
-            self.corpus_cover, self.corpus_mat, has_new, svec = \
-                self._admit_if_new_fn(
-                    self.corpus_cover, self.corpus_mat, self.flakes,
-                    call_ids, pc_idx, valid, jnp.int32(self.corpus_len),
-                    svec, hinc)
+            (self.corpus_cover, self.corpus_mat, has_new, nbits,
+             svec) = self._admit_if_new_fn(
+                self.corpus_cover, self.corpus_mat, self.flakes,
+                call_ids, pc_idx, valid, jnp.int32(self.corpus_len),
+                svec, hinc)
             choices = None
         else:
-            self.corpus_cover, self.corpus_mat, has_new, choices, svec = \
-                self._admit_choices_fn(
-                    self.corpus_cover, self.corpus_mat, self.flakes,
-                    call_ids, pc_idx, valid, jnp.int32(self.corpus_len),
-                    self._next_key(), self.prios, self.enabled,
-                    jnp.asarray(choice_prev, jnp.int32), svec, hinc)
+            (self.corpus_cover, self.corpus_mat, has_new, nbits,
+             choices, svec) = self._admit_choices_fn(
+                self.corpus_cover, self.corpus_mat, self.flakes,
+                call_ids, pc_idx, valid, jnp.int32(self.corpus_len),
+                self._next_key(), self.prios, self.enabled,
+                jnp.asarray(choice_prev, jnp.int32), svec, hinc)
             choices = np.asarray(choices)
         self._ts_out(svec)
         has_new = np.asarray(has_new)
@@ -1093,15 +1281,18 @@ class CoverageEngine:
         rows = np.arange(self.corpus_len, self.corpus_len + len(admitted))
         self.corpus_call[rows] = np.asarray(call_ids)[admitted]
         self.corpus_len += len(admitted)
-        return has_new, rows, choices
+        return has_new, rows, choices, np.asarray(nbits)
 
-    @_locked
     def triage_diff(self, call_ids, pc_idx, valid):
         """Diff vs corpus cover minus flakes (ref triageInput
-        fuzzer.go:384-386); no state mutation."""
+        fuzzer.go:384-386); no state mutation.  The dispatch runs under
+        the state lock; the host sync happens OUTSIDE it, so a slow
+        tunnel round-trip never serializes concurrent engine ops
+        (retired syz-vet device-sync-under-lock P1)."""
         call_ids, pc_idx, valid = self._fit(call_ids, pc_idx, valid)
-        new, has_new, bitmaps = self._diff_vs_fn(
-            self.corpus_cover, call_ids, pc_idx, valid, self.flakes)
+        with self._state_mu:
+            new, has_new, bitmaps = self._diff_vs_fn(
+                self.corpus_cover, call_ids, pc_idx, valid, self.flakes)
         return np.asarray(has_new), new, bitmaps
 
     @_locked
@@ -1204,11 +1395,48 @@ class CoverageEngine:
             self.key, sub = jax.random.split(self.key)
         return sub
 
-    def sample_next_calls(self, prev_call_ids) -> np.ndarray:
-        """One device call → a whole batch of ChoiceTable decisions."""
+    def sample_next_calls(self, prev_call_ids,
+                          overlay: "DeviceOverlay | None" = None
+                          ) -> np.ndarray:
+        """One device call → a whole batch of ChoiceTable decisions,
+        optionally steered by a campaign overlay (fixed-shape operands;
+        the flat path passes the cached neutral overlay)."""
         sub = self._next_key()
         prev = jnp.asarray(prev_call_ids, jnp.int32)
-        return np.asarray(self._sample_fn(sub, self.prios, prev, self.enabled))
+        ov = overlay if overlay is not None else self._ov_neutral
+        return np.asarray(self._sample_fn(sub, self.prios, prev,
+                                          self.enabled, ov.boost,
+                                          ov.enabled))
+
+    def make_overlay(self, name: str, boost, enabled_ids) -> DeviceOverlay:
+        """Compile a campaign overlay into cached device operands:
+        (C,) boost multipliers and the (C,) enabled restriction.  Built
+        once per campaign and reused — a warm swap moves two small
+        replicated buffers and compiles nothing."""
+        b = np.asarray(boost, np.float32)
+        if b.shape != (self.ncalls,):
+            raise ValueError(f"boost shape {b.shape} != ({self.ncalls},)")
+        m = np.zeros((self.ncalls,), bool)
+        m[np.asarray(list(enabled_ids), int)] = True
+        return DeviceOverlay(name=name,
+                             boost=self.put_replicated(b),
+                             enabled=self.put_replicated(m))
+
+    def frontier_view(self, tag: str) -> SparseView:
+        """The per-campaign word-block-sparse frontier view over this
+        engine's shared bitmap (created on first use).  Callers absorb
+        update results into it OUTSIDE the engine lock."""
+        bw = self.block_words if self.W % self.block_words == 0 else 1
+        with self._frontier_mu:
+            v = self._frontiers.get(tag)
+            if v is None:
+                v = self._frontiers[tag] = SparseView(
+                    tag, self.ncalls, self.W, bw)
+            return v
+
+    def frontier_views(self) -> "dict[str, SparseView]":
+        with self._frontier_mu:
+            return dict(self._frontiers)
 
     def put_replicated(self, arr) -> jax.Array:
         """Place a small dispatch operand on the engine's device(s)
@@ -1221,18 +1449,25 @@ class CoverageEngine:
 
     @_locked
     def decision_block(self, hot_prev: jax.Array, per_row: int,
-                       n_rows: int, n_entropy: int) -> DecisionBlock:
+                       n_rows: int, n_entropy: int,
+                       overlay: "DeviceOverlay | None" = None
+                       ) -> DecisionBlock:
         """Dispatch ONE decision-stream megakernel step (async — the
         returned block's fields are device arrays the caller fetches
         later).  `hot_prev` must be a device-cached int32 composition
         (put_replicated); per_row/n_rows/n_entropy are static dispatch
-        shapes the caller keeps in a pow2-bucketed closed set."""
+        shapes the caller keeps in a pow2-bucketed closed set.
+        `overlay` steers the whole block at one campaign's subsystem
+        (fixed-shape operands — the flat path passes the cached
+        neutral overlay, so campaign swaps never recompile)."""
         svec, hinc = self._ts_in()
+        ov = overlay if overlay is not None else self._ov_neutral
         if self._ds_key is None:
             self._ds_key = self._next_key()
         (self._ds_key, base, hot, crows, ent, svec) = self._decision_fn(
             self._ds_key, self.prios, self.enabled, self.corpus_mat,
-            hot_prev, svec, hinc, per_row, n_rows, n_entropy)
+            hot_prev, ov.boost, ov.enabled, svec, hinc,
+            per_row, n_rows, n_entropy)
         self._ts_out(svec)
         return DecisionBlock(base=base, hot=hot, corpus_rows=crows,
                              entropy=ent)
@@ -1242,15 +1477,21 @@ class CoverageEngine:
 
     # -- introspection ---------------------------------------------------
 
-    @_locked
     def cover_counts(self) -> np.ndarray:
-        """(ncalls,) corpus-covered-PC counts (for stats/UI)."""
-        return np.asarray(self._popcount_fn(self.corpus_cover))
+        """(ncalls,) corpus-covered-PC counts (for stats/UI).  Dispatch
+        under the state lock, host sync outside it (retired syz-vet
+        device-sync-under-lock P1 — stats scrapes no longer stall the
+        admission plane for a tunnel round-trip)."""
+        with self._state_mu:
+            dev = self._popcount_fn(self.corpus_cover)
+        return np.asarray(dev)
 
-    @_locked
     def max_cover_counts(self) -> np.ndarray:
-        """(ncalls,) ever-seen-PC counts (max cover, for the /cover UI)."""
-        return np.asarray(self._popcount_fn(self.max_cover))
+        """(ncalls,) ever-seen-PC counts (max cover, for the /cover UI);
+        same dispatch-locked/sync-unlocked split as cover_counts."""
+        with self._state_mu:
+            dev = self._popcount_fn(self.max_cover)
+        return np.asarray(dev)
 
     @_locked
     def covered_indices(self, corpus: bool = True) -> np.ndarray:

@@ -92,6 +92,11 @@ class DecisionStream:
         self._targets[0] += self.hot_slots   # initial hot composition: -1
         self._hot_host = np.full((self.hot_slots,), -1, np.int32)
         self._hot_dev = engine.put_replicated(self._hot_host)
+        # campaign overlay (cover.engine.DeviceOverlay | None): cached
+        # fixed-shape device operands the megakernel consumes — a swap
+        # changes operand contents only, so it rides the invalidate()
+        # epoch path and compiles nothing warm
+        self._overlay = None
         self._warmed = False
         self._starved = False
         # health counters (host-side; the device stat vector carries the
@@ -217,8 +222,9 @@ class DecisionStream:
                          self.UNDERRUN_BATCH, 1024)
         with self._mu:
             epoch = self._epoch
+            overlay = self._overlay
         draws = self.engine.sample_next_calls(
-            np.full((nb,), prev, np.int32))
+            np.full((nb,), prev, np.int32), overlay=overlay)
         if self.tstats is not None:
             self.tstats.inc("ring_underrun")
         with self._mu:
@@ -264,6 +270,25 @@ class DecisionStream:
             warmed = self._warmed
         if warmed:
             self._kick()
+
+    def set_overlay(self, overlay) -> None:
+        """Retarget the stream at a campaign: install the overlay's
+        cached device operands and ride the SAME epoch path as a
+        priority update — stale rings drop, in-flight blocks discard at
+        publish, and the eager background redraw repopulates from the
+        steered distribution.  The overlay operands are fixed (C,)
+        shapes, so a warm rotate-through-campaigns storm compiles
+        nothing (CompileCounter-pinned in tests).  None restores the
+        flat (neutral) overlay."""
+        with self._mu:
+            if overlay is self._overlay:
+                return
+            self._overlay = overlay
+        self.invalidate()
+
+    def overlay(self):
+        with self._mu:
+            return self._overlay
 
     def stop(self) -> None:
         with self._cv:
@@ -316,8 +341,10 @@ class DecisionStream:
             with self._mu:
                 epoch = self._epoch
                 hot_host, hot_dev = self._hot_host, self._hot_dev
+                overlay = self._overlay
             blk = self.engine.decision_block(
-                hot_dev, self.per_row, self.n_rows, self.n_entropy)
+                hot_dev, self.per_row, self.n_rows, self.n_entropy,
+                overlay=overlay)
             prev, self._inflight = self._inflight, (
                 epoch, time.monotonic(), hot_host, blk)
             self._publish(prev)
@@ -400,8 +427,10 @@ class DecisionStream:
         with self._mu:
             epoch = self._epoch
             hot_host, hot_dev = self._hot_host, self._hot_dev
+            overlay = self._overlay
         blk = self.engine.decision_block(
-            hot_dev, self.per_row, self.n_rows, self.n_entropy)
+            hot_dev, self.per_row, self.n_rows, self.n_entropy,
+            overlay=overlay)
         self._publish((epoch, time.monotonic(), hot_host, blk))
 
     def inventory(self) -> int:

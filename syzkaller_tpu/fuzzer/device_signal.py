@@ -67,6 +67,16 @@ class DeviceSignal:
         # keeps growing, so the identity mapping is not guaranteed)
         self._row2corpus: list[int] = []
         self._row_mu = threading.Lock()
+        # active campaign frontier (cover.engine.SparseView | None):
+        # resolve() absorbs each batch's new-signal diffs into it, so
+        # per-campaign coverage rides the dispatches the hot loop
+        # already pays for.  Plain attribute swap (None = flat).
+        self._frontier = None
+
+    def set_frontier(self, view) -> None:
+        """Install the campaign frontier view new signal is attributed
+        to from now on (None = stop attributing)."""
+        self._frontier = view
 
     # -- mapping helpers ---------------------------------------------------
 
@@ -94,12 +104,17 @@ class DeviceSignal:
         # sparse when configured and the batch's footprint fits; the
         # engine falls back to the dense step with identical verdicts
         res = self.engine.update_batch_sparse(call_ids, idx, valid)
-        return (res, owner, len(entries))
+        return (res, owner, len(entries), call_ids, self._frontier)
 
     def resolve(self, ticket) -> np.ndarray:
-        """Fetch a submit_batch verdict: (n_entries,) bool has-new."""
-        res, owner, n = ticket
+        """Fetch a submit_batch verdict: (n_entries,) bool has-new.
+        The active campaign frontier (snapshotted at submit, so a
+        mid-flight campaign swap can't misattribute) absorbs the
+        batch's new-signal diffs here — outside the engine lock."""
+        res, owner, n, call_ids, frontier = ticket
         has_new = np.asarray(res.has_new)        # the host sync
+        if frontier is not None:
+            frontier.absorb(call_ids, res)
         out = np.zeros((n,), bool)
         m = (owner >= 0) & has_new[: len(owner)]
         np.logical_or.at(out, owner[m], True)

@@ -128,6 +128,17 @@ class Fuzzer:
         self._stop = False
         self.ct: "P.ChoiceTable | None" = None
         self.enabled_ids: list[int] = []
+        # campaign plane: the manager assigns a campaign at Connect and
+        # may rotate it via Poll; the fuzzer applies it as a choice-
+        # table overlay (device: epoch-path swap on the decision
+        # stream; host: a boosted ChoiceTable rebuild), a protocol
+        # machine for stateful gen/mutation, and a transition-coverage
+        # view (word-block-sparse over the dense transition-id space)
+        self.campaign = None
+        self.transition_cov = None
+        self._campaign_name: "str | None" = None
+        self._prios: "np.ndarray | None" = None
+        self._tcov_shipped = 0          # poll-delta watermark
         # ONE gate shared by all procs: the leak-scan callback must run
         # with every proc's executions drained (ref fuzzer.go:153-162)
         self.gate = ipc.Gate(2 * max(1, procs),
@@ -149,6 +160,7 @@ class Fuzzer:
             self.candidate_q.append((rpc.unb64(cp["prog"]),
                                      bool(cp.get("minimized"))))
         self.build_call_list(enabled_names, prios)
+        self._apply_campaign(r.get("campaign"))
         self.client.call("Manager.Check", {
             "name": self.name,
             "calls": [self.table.calls[i].name for i in self.enabled_ids]})
@@ -170,6 +182,7 @@ class Fuzzer:
             log.fatalf("no enabled calls after closure")
         if prios is None:
             prios = P.calculate_priorities(self.table)
+        self._prios = prios
         if self.signal is not None:
             # The decision-stream plane (ref prog/prio.go:230-249, fused):
             # one megakernel feeds choice draws, corpus-row picks AND
@@ -183,6 +196,80 @@ class Fuzzer:
         else:
             self.ct = P.ChoiceTable(prios, set(self.enabled_ids),
                                     ncalls=self.table.count)
+
+    # -- campaign plane ----------------------------------------------------
+
+    def _apply_campaign(self, name: "str | None") -> None:
+        """Apply (or clear) the manager-assigned campaign.  Device
+        path: the overlay swaps through DecisionStream.set_overlay —
+        the invalidate() epoch path, fixed-shape operands, zero warm
+        recompiles.  Host path: rebuild the ChoiceTable with boosted
+        columns + the restricted enabled set.  Idempotent per name, so
+        every Poll can re-send the current assignment."""
+        if name == self._campaign_name:
+            return
+        camp = None
+        if name is not None:
+            try:
+                from syzkaller_tpu.campaign import load_campaign
+                camp = load_campaign(name, self.table)
+            except Exception as e:
+                log.logf(0, "campaign %r unavailable, staying flat: %s",
+                         name, e)
+                return
+        with self._mu:
+            self.campaign = camp
+            self._campaign_name = name if camp is not None else None
+            self.transition_cov = (camp.transition_coverage()
+                                   if camp is not None else None)
+            self._tcov_shipped = 0
+        if self.signal is not None:
+            ov = None
+            if camp is not None:
+                ov = self.signal.engine.make_overlay(
+                    camp.name, camp.boost,
+                    camp.restrict_enabled(self.enabled_ids))
+            self.ct.set_overlay(ov)
+            # per-campaign frontier over the shared device bitmap: new
+            # signal from here on is attributed to this campaign
+            self.signal.set_frontier(
+                self.signal.engine.frontier_view(camp.name)
+                if camp is not None else None)
+        else:
+            prios = (self._prios if self._prios is not None
+                     else P.calculate_priorities(self.table))
+            if camp is not None:
+                self.ct = camp.host_choice_table(prios, self.enabled_ids)
+            else:
+                self.ct = P.ChoiceTable(prios, set(self.enabled_ids),
+                                        ncalls=self.table.count)
+        log.logf(0, "campaign: %s", name if camp is not None else "flat")
+
+    def _campaign_generate(self, rand: P.Rand) -> "M.Prog | None":
+        """Stateful generation under the active campaign (seed
+        prologue + protocol-machine walk); records transition coverage.
+        None when no campaign is active."""
+        with self._mu:
+            camp, tcov = self.campaign, self.transition_cov
+        if camp is None:
+            return None
+        p = camp.generate(rand, PROG_NCALLS, self.ct)
+        if tcov is not None:
+            tcov.observe(p.calls)
+        return p
+
+    def _campaign_mutate(self, p: M.Prog, rand: P.Rand,
+                         corpus) -> bool:
+        """Protocol-order-respecting mutation under the active
+        campaign; False = caller should run the flat mutator."""
+        with self._mu:
+            camp, tcov = self.campaign, self.transition_cov
+        if camp is None or camp.machine is None:
+            return False
+        camp.mutate(p, rand, PROG_NCALLS, self.ct, corpus)
+        if tcov is not None:
+            tcov.observe(p.calls)
+        return True
 
     # -- signal helpers ----------------------------------------------------
 
@@ -432,10 +519,17 @@ class Fuzzer:
                     # uniform pick (ref fuzzer.go:224)
                     row = self._pick_corpus_row(len(corpus), rand)
                     p = M.clone_prog(corpus[row])
-                    P.mutate(p, rand, self.table, PROG_NCALLS, self.ct, corpus)
+                    # under a campaign with a protocol machine, the
+                    # sequence mutator keeps protocol order (extend /
+                    # repair / trim); flat mutation otherwise
+                    if not self._campaign_mutate(p, rand, corpus):
+                        P.mutate(p, rand, self.table, PROG_NCALLS,
+                                 self.ct, corpus)
                     stat = "exec fuzz"
                 else:
-                    p = self.generate_seeded(rand, choice)
+                    p = self._campaign_generate(rand)
+                    if p is None:
+                        p = self.generate_seeded(rand, choice)
                     stat = "exec gen"
                 with gate.section():
                     res = self.execute(env, p, stat, pid)
@@ -576,6 +670,14 @@ class Fuzzer:
                     stats[wire] = d
         with self._mu:
             need = len(self.candidate_q) == 0
+            tcov = self.transition_cov
+        if tcov is not None:
+            # protocol-transition coverage rides the legacy stat wire
+            # as deltas (the manager's StatsView sums across VMs)
+            cov = tcov.popcount()
+            if cov > self._tcov_shipped:
+                stats["campaign transitions"] = cov - self._tcov_shipped
+                self._tcov_shipped = cov
         r = self.client.call("Manager.Poll", {
             "name": self.name, "stats": stats, "need_candidates": need},
             span=self.tracer.new_trace(origin=self.name))
@@ -584,6 +686,9 @@ class Fuzzer:
                                      bool(cp.get("minimized"))))
         for inp in r.get("new_inputs", []):
             self.add_input(inp)
+        # campaign rotation rides the Poll response: applying the same
+        # name is a no-op, a new one swaps the overlay epoch-style
+        self._apply_campaign(r.get("campaign"))
         choices = r.get("choices") or []
         with self._mu:
             self.device_choices.extend(int(x) for x in choices)

@@ -205,8 +205,9 @@ class AdmissionCoalescer:
             pval[:n] = valid
             prev = np.full((self.choices_per_step,), -1, np.int32)
             t_disp = time.monotonic()
-            has_new, rows, choices = mgr.engine.admit_batch(
-                call_ids, pidx, pval, choice_prev=prev)
+            has_new, rows, choices, new_bits = mgr.engine.admit_batch(
+                call_ids, pidx, pval, choice_prev=prev,
+                with_new_bits=True)
             t_done = time.monotonic()
             ds = mgr.device_stats
             if ds is not None:
@@ -224,6 +225,12 @@ class AdmissionCoalescer:
             self._refill_choices(choices)
             admitted: list[tuple[_Pending, int]] = []
             cursor = 0
+            for j, p in enumerate(fresh):
+                if has_new[j]:
+                    # campaign attribution: new-bit counts feed the
+                    # per-campaign new_cov_per_1k_exec EWMA + corpus tag
+                    mgr.campaign_sched.note_new_cov(
+                        p.name, int(new_bits[j]), sig_hex=p.sig.hex())
             with mgr._mu:
                 for j, p in enumerate(fresh):
                     if not has_new[j]:

@@ -54,6 +54,17 @@ class Config:
     #                                    BASELINE config #4's device mesh)
     mesh_platform: str = ""            # pin mesh devices to a platform
     #                                    ("cpu" = virtual-device mesh)
+    campaigns: list = field(default_factory=list)
+    #                                  # stateful-subsystem campaigns to
+    #                                    rotate fuzzer connections over
+    #                                    (names of descriptions/campaigns/
+    #                                    *.campaign; [] = flat fuzzing)
+    campaign_rotation: float = 0.0     # rotate a connection when its
+    #                                    campaign's new_cov_per_1k_exec
+    #                                    EWMA decays below this
+    #                                    (0 = never rotate)
+    campaign_min_execs: int = 2000     # rotation arms only after this
+    #                                    many execs under the campaign
     # VM-type specific (qemu)
     kernel: str = ""
     image: str = ""
@@ -127,6 +138,29 @@ class Config:
         if self.telemetry_interval <= 0:
             raise ConfigError(
                 f"invalid telemetry_interval {self.telemetry_interval}")
+        # campaign knobs: an unknown campaign name is a STARTUP error —
+        # silently degrading to flat mode would defeat the whole point
+        # of configuring a steered run.  Pure file listing (no table
+        # compile, no accelerator init).
+        if self.campaigns:
+            from syzkaller_tpu.sys.campaigns import available_campaigns
+            have = set(available_campaigns())
+            unknown = [c for c in self.campaigns if c not in have]
+            if unknown:
+                raise ConfigError(
+                    f"unknown campaigns {unknown} (have: {sorted(have)})")
+            if len(set(self.campaigns)) != len(self.campaigns):
+                raise ConfigError(
+                    f"duplicate campaign names in {self.campaigns}")
+        if self.campaign_rotation < 0:
+            raise ConfigError(
+                f"invalid campaign_rotation {self.campaign_rotation}")
+        if self.campaign_rotation > 0 and not self.campaigns:
+            raise ConfigError(
+                "campaign_rotation set but no campaigns configured")
+        if self.campaign_min_execs < 0:
+            raise ConfigError(
+                f"invalid campaign_min_execs {self.campaign_min_execs}")
         # NOTE: device availability for `mesh` is checked when the
         # manager builds the engine (cover.engine.pc_mesh raises) —
         # config linting must not initialize an accelerator runtime.

@@ -214,6 +214,22 @@ class Manager:
                                       telemetry=self.device_stats,
                                       warm_after=3)
 
+        # campaign plane: assignment + decay-triggered rotation + the
+        # syz_new_cov_per_1k_exec gauge family (global label always
+        # registered, per-campaign labels when campaigns are
+        # configured).  Each active campaign gets its OWN decision
+        # stream over the shared engine — N concurrent steered
+        # frontiers, one device bitmap — with the overlay applied as
+        # fixed-shape operands (warm swaps compile nothing).
+        from syzkaller_tpu.campaign import CampaignScheduler
+        self.campaign_sched = CampaignScheduler(
+            cfg.campaigns, rotation=cfg.campaign_rotation,
+            min_execs=cfg.campaign_min_execs, registry=self.registry)
+        self.campaign_sched.restore(cfg.workdir)
+        self._campaigns: dict = {}            # name -> campaign.Campaign
+        self._campaign_streams: dict = {}     # name -> DecisionStream
+        self._camp_mu = threading.Lock()
+
         # batched admission plane: concurrent NewInput RPCs coalesce
         # into fused device dispatches instead of paying one device
         # round-trip per input (round-2 verdict weak #5)
@@ -357,13 +373,18 @@ class Manager:
         with self._mu:
             self.fuzzers[name] = FuzzerConn(name=name)
             cands = self._pop_candidates(CANDIDATES_PER_POLL)
-        log.logf(0, "fuzzer %s connected", name)
-        return {
+        camp = self.campaign_sched.assign(name)
+        log.logf(0, "fuzzer %s connected%s", name,
+                 f" (campaign {camp})" if camp else "")
+        resp = {
             "prios": rpc.b64(np.asarray(self.engine.prios, np.float32)
                              .tobytes()),
             "enabled": self.enabled_names,
             "candidates": cands,
         }
+        if camp is not None:
+            resp["campaign"] = camp
+        return resp
 
     def rpc_check(self, params: dict) -> dict:
         name = params.get("name", "?")
@@ -391,6 +412,14 @@ class Manager:
                 self._f_vm_execs.labels(vm=name).inc(int(v))
                 self._f_vm_rate.labels(vm=name).add(int(v))
                 self._e_exec_rate.add(int(v))
+                # campaign productivity: the denominator of
+                # new_cov_per_1k_exec (global + this conn's campaign)
+                self.campaign_sched.note_execs(name, int(v))
+        # decay-triggered rotation (cheap: two EWMA reads); the new
+        # assignment rides this Poll response so the fuzzer swaps its
+        # overlay via the invalidate() epoch path before the next gen
+        self.campaign_sched.maybe_rotate(name)
+        camp = self.campaign_sched.current(name)
         with self._mu:
             conn = self.fuzzers.get(name)
             if conn is None:
@@ -400,6 +429,19 @@ class Manager:
                 inputs.append(conn.input_queue.popleft())
             cands = (self._pop_candidates(CANDIDATES_PER_POLL)
                      if params.get("need_candidates") else [])
+        if camp is not None:
+            # steered connection: choices come from the campaign's own
+            # decision stream (overlay applied inside the megakernel) —
+            # the flat admission ring would leak out-of-campaign calls
+            t0 = time.monotonic()
+            choices = self._campaign_stream(camp).take(-1,
+                                                       CHOICES_PER_POLL)
+            if self.device_stats is not None:
+                self.device_stats.observe("choice_draw_latency",
+                                          time.monotonic() - t0)
+            self._c_choices_topup.inc(CHOICES_PER_POLL)
+            return {"candidates": cands, "new_inputs": inputs,
+                    "choices": choices, "campaign": camp}
         # choices come from the coalescer's pre-drawn device ring when
         # admissions are flowing (the draws fused into admission
         # dispatches); the direct sampling dispatch only tops up the
@@ -421,6 +463,48 @@ class Manager:
             self._c_choices_topup.inc(short)
         return {"candidates": cands, "new_inputs": inputs,
                 "choices": choices}
+
+    # -- campaign plane ----------------------------------------------------
+
+    def _campaign(self, name: str):
+        """Lazily-loaded campaign runtime (description parse + glob
+        resolution happen OUTSIDE _camp_mu — file I/O under a lock is
+        a syz-vet P0 — with a double-checked insert)."""
+        with self._camp_mu:
+            c = self._campaigns.get(name)
+        if c is not None:
+            return c
+        from syzkaller_tpu.campaign import load_campaign
+        c = load_campaign(name, self.table)
+        with self._camp_mu:
+            return self._campaigns.setdefault(name, c)
+
+    def _campaign_stream(self, name: str):
+        """The campaign's decision stream over the shared engine,
+        created on first use: overlay operands built once
+        (make_overlay device_puts two small buffers), then every swap
+        and refill moves contents only."""
+        with self._camp_mu:
+            s = self._campaign_streams.get(name)
+        if s is not None:
+            return s
+        from syzkaller_tpu.fuzzer.device_ct import DecisionStream
+        c = self._campaign(name)
+        ov = self.engine.make_overlay(name, c.boost, c.enabled_ids)
+        s = DecisionStream(self.engine, per_row=64,
+                           telemetry=self.device_stats, warm_after=3)
+        s.set_overlay(ov)
+        with self._camp_mu:
+            exist = self._campaign_streams.get(name)
+            if exist is not None:
+                stale = s
+            else:
+                self._campaign_streams[name] = s
+                stale = None
+        if stale is not None:
+            stale.stop()
+            return self._campaign_streams[name]
+        return s
 
     def rpc_new_input(self, params: dict) -> dict:
         name = params.get("name", "?")
@@ -464,8 +548,9 @@ class Manager:
                     return {}
             idx, valid = self.pcmap.map_batch([cover], K=256)
             t_disp = time.monotonic()
-            has_new, rows = self.engine.admit_if_new(
-                np.array([call_id], np.int32), idx, valid)
+            has_new, rows, new_bits = self.engine.admit_if_new(
+                np.array([call_id], np.int32), idx, valid,
+                with_new_bits=True)
             if self.device_stats is not None:
                 self.device_stats.observe("admission_latency",
                                           time.monotonic() - t_start)
@@ -477,6 +562,8 @@ class Manager:
             if not has_new[0]:
                 self._c_rejected.inc()
                 return {}
+            self.campaign_sched.note_new_cov(name, int(new_bits[0]),
+                                             sig_hex=sig.hex())
             row = (int(rows[0]) if rows is not None and len(rows) else -1)
             with self._mu:
                 self.corpus[sig] = CorpusItem(
@@ -543,6 +630,10 @@ class Manager:
         # stream schedules its redraw eagerly off-thread, so the next
         # Poll top-up finds a warm ring instead of a cold refill
         self.dstream.invalidate()
+        with self._camp_mu:
+            streams = list(self._campaign_streams.values())
+        for s in streams:
+            s.invalidate()
 
     # -- hub federation (ref manager.go:658-736) ---------------------------
 
@@ -903,6 +994,7 @@ class Manager:
             expo.persist_snapshot(self.cfg.workdir, self.telemetry_snapshot())
         except Exception as e:
             log.logf(1, "telemetry persistence failed: %s", e)
+        self.campaign_sched.persist(self.cfg.workdir)
 
     def run(self, duration: "float | None" = None) -> None:
         self.start()
@@ -938,6 +1030,12 @@ class Manager:
         if self.coalescer is not None:
             self.coalescer.stop()
         self.dstream.stop()
+        with self._camp_mu:
+            camp_streams = list(self._campaign_streams.values())
+            self._campaign_streams.clear()
+        for s in camp_streams:
+            s.stop()
+        self.campaign_sched.persist(self.cfg.workdir)
         with self._repro_mu:
             sched, oracle = self._repro_sched, self._repro_oracle
             self._repro_sched = self._repro_oracle = None

@@ -19,7 +19,7 @@ from syzkaller_tpu.prog.encoding import (  # noqa: F401
 from syzkaller_tpu.prog.encodingexec import serialize_for_exec  # noqa: F401
 from syzkaller_tpu.prog.generation import generate  # noqa: F401
 from syzkaller_tpu.prog.mutation import (  # noqa: F401
-    minimize, minimize_steps, mutate, trim_after,
+    minimize, minimize_steps, mutate, mutate_sequence, trim_after,
 )
 from syzkaller_tpu.prog.parse import parse_log  # noqa: F401
 from syzkaller_tpu.prog.prio import ChoiceTable, calculate_priorities  # noqa: F401

@@ -49,6 +49,88 @@ def mutate(p: M.Prog, rand: Rand, table: SyscallTable, ncalls: int = 30,
         p.calls.extend(gen.generate_call(-1))
 
 
+def mutate_sequence(p: M.Prog, rand: Rand, table: SyscallTable, machine,
+                    ncalls: int = 30, choice_table=None,
+                    pid: int = 0) -> None:
+    """State-machine sequence mutation: mutate p while RESPECTING the
+    campaign's protocol order.  `machine` is duck-typed (campaign.
+    ProtocolMachine): walk(calls) -> Walk, enabled_transitions(state),
+    build_call(gen, transition).
+
+    Three protocol-preserving operators, weighted like the flat
+    mutator's insert/mutate/remove split:
+
+      * extend — append a call that takes an enabled transition from
+        the program's CURRENT final protocol state (deepens the
+        sequence: handshake grows toward teardown instead of emitting
+        another uncorrelated SYN);
+      * mutate-arg — per-arg mutation on one call, then REPAIR: if the
+        mutation knocked the call out of its transition (flag word
+        changed), the protocol suffix no longer replays, so trim the
+        tail back to the longest prefix whose walk is unchanged;
+      * trim — drop the protocol tail (the teardown half of a
+        sequence), letting the extender regrow a different suffix.
+
+    Non-protocol calls interleaved in the program are left to the flat
+    arg mutator — the machine's classify() ignores them, so they never
+    perturb the walk."""
+    r = rand
+    base_walk = machine.walk(p.calls)
+    first = True
+    while first or r.one_of(2):
+        first = False
+        which = r.choose_weighted([20, 10, 2])
+        if which == 0 and len(p.calls) < ncalls:
+            # extend along the machine from the current final state
+            nexts = machine.enabled_transitions(base_walk.final_state)
+            if not nexts:
+                # terminal protocol state: restart the protocol tail
+                nexts = machine.enabled_transitions(machine.initial)
+            if not nexts:
+                continue
+            t = nexts[r.intn(len(nexts))]
+            state = State(table)
+            for c in p.calls:
+                state.analyze_call(c)
+            gen = Gen(rand, state, table, choice_table, pid)
+            try:
+                p.calls.extend(machine.build_call(gen, t))
+            except Exception:
+                continue
+            base_walk = machine.walk(p.calls)
+        elif which == 1 and p.calls:
+            before = machine.walk(p.calls).transitions
+            _mutate_arg(p, rand, table, choice_table, pid)
+            after = machine.walk(p.calls).transitions
+            if after[: len(before)] != before[: len(after)] or \
+                    len(after) < len(before):
+                # the mutation broke a transition mid-sequence: keep it
+                # (the flag word itself is fuzz-worthy) but trim the
+                # now-unreachable protocol tail so order stays honest
+                _trim_to_prefix(p, machine, len(after))
+            base_walk = machine.walk(p.calls)
+        elif which == 2 and len(base_walk.transitions) > 1:
+            keep = r.intn(len(base_walk.transitions))
+            _trim_to_prefix(p, machine, keep)
+            base_walk = machine.walk(p.calls)
+    while len(p.calls) > ncalls:
+        M.remove_call(p, len(p.calls) - 1)
+    if not p.calls:
+        state = State(table)
+        gen = Gen(rand, state, table, choice_table, pid)
+        p.calls.extend(gen.generate_call(-1))
+
+
+def _trim_to_prefix(p: M.Prog, machine, keep_transitions: int) -> None:
+    """Remove trailing calls until the walk takes at most
+    `keep_transitions` transitions (protocol-order-preserving trim:
+    only whole tail calls go, so the remaining prefix replays
+    identically)."""
+    while len(p.calls) > 1 and \
+            len(machine.walk(p.calls).transitions) > keep_transitions:
+        M.remove_call(p, len(p.calls) - 1)
+
+
 def _splice(p: M.Prog, rand: Rand, corpus: list[M.Prog], ncalls: int) -> None:
     other = M.clone_prog(corpus[rand.intn(len(corpus))])
     idx = rand.intn(len(p.calls) + 1)

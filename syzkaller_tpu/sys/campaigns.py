@@ -1,0 +1,297 @@
+"""Campaign description syntax + compiler.
+
+A campaign is a declarative overlay over the syscall descriptions that
+retargets the whole fuzzing plane at one subsystem without recompiling
+anything: an enabled call set, priority-matrix boosts, an optional
+protocol state machine, and a resource seed policy.  Campaign files
+live next to the syscall descriptions (descriptions/campaigns/
+*.campaign — a separate extension so load_table's `**/*.txt` glob never
+tries to parse them as syzlang) and use a line-oriented directive
+syntax:
+
+    campaign vnet-tcp                    # required, first directive
+    calls  openat$tun, syz_emit_*       # enabled-set globs (repeatable)
+    boost  4.0 syz_emit_ethernet$*      # priority multiplier (repeatable)
+    seed   openat$tun, ioctl$TUNSETIFF  # ordered resource-seed prologue
+    state  CLOSED initial               # protocol states (optional)
+    state  SYN_SENT
+    transition syn CLOSED -> SYN_SENT call syz_emit_ethernet$ipv4 flag 0x5002
+
+`transition` matches a call by name glob and, when `flag` is given, by
+the presence of a const/flags argument with that exact value anywhere in
+the call's argument tree — enough to distinguish a SYN from a FIN
+emitted through the same typed vnet frame.  The compiler resolves every
+glob against a SyscallTable; a glob matching nothing is an error (a
+campaign silently degrading to flat soup is the failure mode this
+syntax exists to prevent).
+"""
+
+from __future__ import annotations
+
+import fnmatch
+import glob as globlib
+import os
+from dataclasses import dataclass, field
+
+from syzkaller_tpu.sys.parser import ParseError
+from syzkaller_tpu.sys.table import SyscallTable
+
+CAMPAIGN_EXT = ".campaign"
+
+
+class CampaignError(Exception):
+    """Campaign compile error (glob matches nothing, bad state refs)."""
+
+
+# ---------------------------------------------------------------------------
+# AST
+
+
+@dataclass
+class TransitionDef:
+    name: str
+    src: str
+    dst: str
+    call_glob: str
+    flag: "int | None" = None
+    line: int = 0
+
+
+@dataclass
+class CampaignDef:
+    name: str
+    calls: list[str] = field(default_factory=list)        # globs
+    boosts: list[tuple[float, str]] = field(default_factory=list)
+    seeds: list[str] = field(default_factory=list)        # ordered names
+    states: list[str] = field(default_factory=list)
+    initial: "str | None" = None
+    transitions: list[TransitionDef] = field(default_factory=list)
+    filename: str = ""
+
+
+def _split_names(rest: str) -> list[str]:
+    out = []
+    for tok in rest.replace(",", " ").split():
+        if tok:
+            out.append(tok)
+    return out
+
+
+def parse_campaign(text: str, filename: str = "<string>") -> CampaignDef:
+    cdef: "CampaignDef | None" = None
+    for lineno, raw in enumerate(text.split("\n"), start=1):
+        line = raw.split("#", 1)[0].strip()
+        if not line:
+            continue
+        parts = line.split(None, 1)
+        kw, rest = parts[0], (parts[1] if len(parts) > 1 else "")
+        if kw == "campaign":
+            if cdef is not None:
+                raise ParseError(filename, lineno,
+                                 "duplicate campaign directive")
+            if not rest:
+                raise ParseError(filename, lineno, "campaign needs a name")
+            cdef = CampaignDef(name=rest.strip(), filename=filename)
+            continue
+        if cdef is None:
+            raise ParseError(filename, lineno,
+                             "campaign directive must come first")
+        if kw == "calls":
+            cdef.calls.extend(_split_names(rest))
+        elif kw == "boost":
+            toks = _split_names(rest)
+            if len(toks) < 2:
+                raise ParseError(filename, lineno,
+                                 "boost needs: <weight> <glob...>")
+            try:
+                w = float(toks[0])
+            except ValueError:
+                raise ParseError(filename, lineno,
+                                 f"bad boost weight {toks[0]!r}")
+            if w <= 0:
+                raise ParseError(filename, lineno,
+                                 "boost weight must be > 0")
+            for g in toks[1:]:
+                cdef.boosts.append((w, g))
+        elif kw == "seed":
+            cdef.seeds.extend(_split_names(rest))
+        elif kw == "state":
+            toks = _split_names(rest)
+            if not toks:
+                raise ParseError(filename, lineno, "state needs a name")
+            st = toks[0]
+            if st in cdef.states:
+                raise ParseError(filename, lineno, f"duplicate state {st}")
+            cdef.states.append(st)
+            if len(toks) > 1:
+                if toks[1] != "initial":
+                    raise ParseError(filename, lineno,
+                                     f"unknown state attr {toks[1]!r}")
+                if cdef.initial is not None:
+                    raise ParseError(filename, lineno,
+                                     "two initial states")
+                cdef.initial = st
+        elif kw == "transition":
+            toks = rest.split()
+            # <name> <FROM> -> <TO> call <glob> [flag <int>]
+            if len(toks) < 6 or toks[2] != "->" or toks[4] != "call":
+                raise ParseError(
+                    filename, lineno,
+                    "transition needs: <name> <FROM> -> <TO> call <glob> "
+                    "[flag <int>]")
+            flag = None
+            if len(toks) > 6:
+                if len(toks) != 8 or toks[6] != "flag":
+                    raise ParseError(filename, lineno,
+                                     "trailing junk after transition")
+                try:
+                    flag = int(toks[7], 0)
+                except ValueError:
+                    raise ParseError(filename, lineno,
+                                     f"bad flag value {toks[7]!r}")
+            cdef.transitions.append(TransitionDef(
+                name=toks[0], src=toks[1], dst=toks[3], call_glob=toks[5],
+                flag=flag, line=lineno))
+        else:
+            raise ParseError(filename, lineno,
+                             f"unknown campaign directive {kw!r}")
+    if cdef is None:
+        raise ParseError(filename, 0, "no campaign directive")
+    return cdef
+
+
+def parse_campaign_file(path: str) -> CampaignDef:
+    with open(path) as f:
+        return parse_campaign(f.read(), path)
+
+
+# ---------------------------------------------------------------------------
+# Discovery (pure file listing — config validation runs this and must
+# not initialize an accelerator runtime or compile the syscall table)
+
+
+def campaign_dir(desc_dir: "str | None" = None) -> str:
+    from syzkaller_tpu.sys.table import DESC_DIR
+
+    return os.path.join(os.path.abspath(desc_dir or DESC_DIR), "campaigns")
+
+
+def available_campaigns(desc_dir: "str | None" = None) -> list[str]:
+    """Names of the shipped campaign descriptions (file stem == the
+    `campaign` directive name, enforced at compile)."""
+    d = campaign_dir(desc_dir)
+    out = []
+    for p in sorted(globlib.glob(os.path.join(d, "*" + CAMPAIGN_EXT))):
+        out.append(os.path.basename(p)[: -len(CAMPAIGN_EXT)])
+    return out
+
+
+def campaign_path(name: str, desc_dir: "str | None" = None) -> str:
+    p = os.path.join(campaign_dir(desc_dir), name + CAMPAIGN_EXT)
+    if not os.path.exists(p):
+        raise CampaignError(
+            f"unknown campaign {name!r} (have: {available_campaigns(desc_dir)})")
+    return p
+
+
+# ---------------------------------------------------------------------------
+# Compiler: resolve globs against a SyscallTable
+
+
+@dataclass
+class CompiledTransition:
+    tid: int                    # dense transition id (bitmap index)
+    name: str
+    src: str
+    dst: str
+    call_ids: frozenset        # syscall ids the glob resolved to
+    flag: "int | None"
+
+
+@dataclass
+class CompiledCampaign:
+    name: str
+    enabled_ids: list[int]              # sorted, closure-valid
+    boost: "object"                     # (ncalls,) float32 np array
+    seed_ids: list[int]                 # ordered prologue call ids
+    states: list[str]
+    initial: "str | None"
+    transitions: list[CompiledTransition]
+
+    @property
+    def has_machine(self) -> bool:
+        return bool(self.states and self.transitions)
+
+
+def _resolve_glob(pattern: str, names: list[str], where: str) -> list[str]:
+    if any(ch in pattern for ch in "*?["):
+        hits = fnmatch.filter(names, pattern)
+    else:
+        hits = [pattern] if pattern in names else []
+    if not hits:
+        raise CampaignError(f"{where}: {pattern!r} matches no syscall")
+    return hits
+
+
+def compile_campaign(cdef: CampaignDef, table: SyscallTable
+                     ) -> CompiledCampaign:
+    import numpy as np
+
+    names = [c.name for c in table.calls]
+    where = f"campaign {cdef.name}"
+    if not cdef.calls:
+        raise CampaignError(f"{where}: no calls directive")
+    enabled: set[str] = set()
+    for g in cdef.calls:
+        enabled.update(_resolve_glob(g, names, f"{where}: calls"))
+    # transitive closure: every input resource needs an in-set ctor,
+    # otherwise generation under the overlay would dead-end
+    metas = {table.call_map[n] for n in enabled}
+    closed = table.transitively_enabled_calls(metas)
+    if not closed:
+        raise CampaignError(f"{where}: enabled set empty after closure")
+    enabled_ids = sorted(c.id for c in closed)
+
+    boost = np.ones((table.count,), np.float32)
+    for w, g in cdef.boosts:
+        for n in _resolve_glob(g, names, f"{where}: boost"):
+            boost[table.call_map[n].id] *= np.float32(w)
+
+    seed_ids = []
+    for n in cdef.seeds:
+        hits = _resolve_glob(n, names, f"{where}: seed")
+        seed_ids.append(table.call_map[hits[0]].id)
+
+    states = list(cdef.states)
+    initial = cdef.initial
+    if cdef.transitions and not states:
+        raise CampaignError(f"{where}: transitions without states")
+    if states and initial is None:
+        raise CampaignError(f"{where}: no initial state")
+    transitions = []
+    for i, t in enumerate(cdef.transitions):
+        for st in (t.src, t.dst):
+            if st not in states:
+                raise CampaignError(
+                    f"{where}: transition {t.name} references undefined "
+                    f"state {st!r}")
+        hits = _resolve_glob(t.call_glob, names,
+                             f"{where}: transition {t.name}")
+        transitions.append(CompiledTransition(
+            tid=i, name=t.name, src=t.src, dst=t.dst,
+            call_ids=frozenset(table.call_map[n].id for n in hits),
+            flag=t.flag))
+    return CompiledCampaign(
+        name=cdef.name, enabled_ids=enabled_ids, boost=boost,
+        seed_ids=seed_ids, states=states, initial=initial,
+        transitions=transitions)
+
+
+def load_compiled(name: str, table: SyscallTable,
+                  desc_dir: "str | None" = None) -> CompiledCampaign:
+    cdef = parse_campaign_file(campaign_path(name, desc_dir))
+    if cdef.name != name:
+        raise CampaignError(
+            f"campaign file {name}{CAMPAIGN_EXT} declares name "
+            f"{cdef.name!r} (must match the file stem)")
+    return compile_campaign(cdef, table)

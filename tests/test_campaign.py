@@ -1,0 +1,460 @@
+"""Campaign-plane tests: description syntax + compiler, overlay draw
+distribution (chi-square vs the exact boosted categorical), per-campaign
+frontier views vs serial replay (exact bitmap equality), zero warm
+recompiles across a rotate-through-all-campaigns storm, the vnet-tcp
+protocol-depth acceptance (stateful campaign reaches states an
+equal-exec flat-soup run does not, tracked in the transition-coverage
+view), the scheduler (assignment, EWMA gauge, decay rotation, corpus-tag
+persistence), and the manager integration."""
+
+import math
+import os
+import tempfile
+
+import numpy as np
+import pytest
+
+from syzkaller_tpu import prog as P
+from syzkaller_tpu.campaign import (CampaignError, CampaignScheduler,
+                                    available_campaigns, load_campaign)
+from syzkaller_tpu.cover.engine import CoverageEngine, merge_views
+from syzkaller_tpu.fuzzer.device_ct import DecisionStream
+from syzkaller_tpu.sys import campaigns as SC
+from syzkaller_tpu.sys.table import load_table
+
+NCALLS = 8
+NPCS = 1 << 12
+
+
+@pytest.fixture(scope="module")
+def table():
+    return load_table()
+
+
+def chi2_crit(df: int, z: float = 3.72) -> float:
+    """~p=1e-4 critical value (Wilson–Hilferty), as in
+    test_decision_stream.py: loose enough never to flake on a fixed
+    seed, tight enough that a wrong distribution fails by orders of
+    magnitude."""
+    a = 2.0 / (9.0 * df)
+    return df * (1.0 - a + z * math.sqrt(a)) ** 3
+
+
+def chi2_stat(obs: np.ndarray, exp: np.ndarray) -> float:
+    m = exp > 0
+    return float((((obs - exp) ** 2)[m] / exp[m]).sum())
+
+
+# ---------------------------------------------------------------------------
+# description syntax + compiler
+
+
+def test_shipped_campaigns_compile(table):
+    names = available_campaigns()
+    assert {"vnet-tcp", "kvm-guest", "fs-image"} <= set(names)
+    for name in names:
+        c = load_campaign(name, table)
+        assert c.enabled_ids, name
+        assert len(c.enabled_ids) < table.count          # a real subset
+        assert c.boost.shape == (table.count,)
+        assert (c.boost >= 1.0).all() and (c.boost > 1.0).any()
+        # boosts only land on enabled calls' columns
+        boosted = set(np.nonzero(c.boost > 1.0)[0].tolist())
+        assert boosted <= set(c.enabled_ids), name
+        # seed calls are enabled
+        assert set(c.seed_ids) <= set(c.enabled_ids)
+    # all three shipped shapes carry a machine
+    assert load_campaign("vnet-tcp", table).machine.n_transitions == 10
+    assert load_campaign("kvm-guest", table).machine is not None
+    assert load_campaign("fs-image", table).machine is not None
+
+
+def test_campaign_parse_errors(table):
+    with pytest.raises(SC.CampaignError):
+        SC.campaign_path("no-such-campaign")
+    # a glob matching nothing is a compile error, not silent flat mode
+    cdef = SC.parse_campaign(
+        "campaign x\ncalls no_such_call_anywhere*\n", "<t>")
+    with pytest.raises(CampaignError):
+        SC.compile_campaign(cdef, table)
+    # transitions need states, states need an initial
+    bad = SC.parse_campaign(
+        "campaign x\ncalls mmap\nstate A\n"
+        "transition t A -> A call mmap\n", "<t>")
+    with pytest.raises(CampaignError):
+        SC.compile_campaign(bad, table)
+    # undefined state reference
+    bad2 = SC.parse_campaign(
+        "campaign x\ncalls mmap\nstate A initial\n"
+        "transition t A -> B call mmap\n", "<t>")
+    with pytest.raises(CampaignError):
+        SC.compile_campaign(bad2, table)
+    from syzkaller_tpu.sys.parser import ParseError
+    with pytest.raises(ParseError):
+        SC.parse_campaign("calls mmap\n", "<t>")         # no header
+    with pytest.raises(ParseError):
+        SC.parse_campaign("campaign x\nboost mmap\n", "<t>")
+
+
+def test_config_campaign_validation():
+    from syzkaller_tpu.manager.config import Config, ConfigError
+
+    Config(campaigns=["vnet-tcp", "kvm-guest"],
+           campaign_rotation=2.0).validate()
+    with pytest.raises(ConfigError, match="unknown campaigns"):
+        Config(campaigns=["vnet-tcp", "nope"]).validate()
+    with pytest.raises(ConfigError, match="duplicate"):
+        Config(campaigns=["vnet-tcp", "vnet-tcp"]).validate()
+    with pytest.raises(ConfigError, match="campaign_rotation"):
+        Config(campaign_rotation=1.0).validate()
+    with pytest.raises(ConfigError):
+        Config(campaigns=["fs-image"], campaign_rotation=-1.0).validate()
+
+
+# ---------------------------------------------------------------------------
+# overlay draw distribution
+
+
+def make_engine(seed=3):
+    eng = CoverageEngine(npcs=NPCS, ncalls=NCALLS, corpus_cap=64,
+                         seed=seed)
+    prios = (np.arange(NCALLS * NCALLS, dtype=np.float32)
+             .reshape(NCALLS, NCALLS) % 7 + 1.0) / 7.0
+    eng.set_priorities(prios)
+    eng.set_enabled(range(NCALLS))
+    return eng, prios
+
+
+def test_overlay_draws_match_boosted_distribution():
+    """Chi-square proof: draws under a campaign overlay land ONLY in
+    the overlay's enabled set and match the boosted categorical
+    p ∝ prios[prev] * boost * enabled — on the megakernel base rows,
+    the hot extension path, and the direct (underrun) draw."""
+    eng, prios = make_engine()
+    boost = np.ones(NCALLS, np.float32)
+    boost[[2, 5]] = 4.0
+    ov_enabled = [0, 2, 3, 5]
+    ov = eng.make_overlay("t", boost, ov_enabled)
+    stream = DecisionStream(eng, per_row=512, hot_slots=64,
+                            corpus_rows=32, entropy_words=1024,
+                            autostart=False)
+    stream.set_overlay(ov)
+    N = 4096
+    mask = np.zeros(NCALLS, bool)
+    mask[ov_enabled] = True
+    for prev in (-1, 3, 6):
+        w = np.where(mask, np.ones(NCALLS) if prev < 0 else prios[prev],
+                     0.0) * boost
+        p = w / w.sum()
+        fused = []
+        while len(fused) < N:
+            blk = eng.decision_block(stream._hot_dev, stream.per_row,
+                                     stream.n_rows, stream.n_entropy,
+                                     overlay=ov)
+            fused.extend(np.asarray(blk.base)[prev + 1].tolist())
+        fused = np.asarray(fused[:N])
+        direct = eng.sample_next_calls(np.full((N,), prev, np.int32),
+                                       overlay=ov)
+        assert set(np.unique(fused)) <= set(ov_enabled), prev
+        assert set(np.unique(direct)) <= set(ov_enabled), prev
+        df = int((p > 0).sum()) - 1
+        crit = chi2_crit(df)
+        obs_f = np.bincount(fused, minlength=NCALLS)
+        obs_d = np.bincount(direct, minlength=NCALLS)
+        assert chi2_stat(obs_f, N * p) < crit, (prev, obs_f, N * p)
+        assert chi2_stat(obs_d, N * p) < crit, (prev, obs_d, N * p)
+    # flat draws on the same engine are untouched by the overlay's
+    # existence (neutral operands)
+    flat = eng.sample_next_calls(np.full((N,), -1, np.int32))
+    assert set(np.unique(flat)) == set(range(NCALLS))
+
+
+def test_stream_overlay_swap_changes_draws():
+    """set_overlay rides the invalidate() epoch path: after the swap,
+    every draw (ring or underrun) comes from the new overlay's enabled
+    set — no stale steered draws leak through."""
+    eng, _ = make_engine()
+    a = eng.make_overlay("a", np.ones(NCALLS, np.float32), [1, 4])
+    b = eng.make_overlay("b", np.ones(NCALLS, np.float32), [2, 6])
+    stream = DecisionStream(eng, per_row=32, hot_slots=64, corpus_rows=32,
+                            entropy_words=1024, autostart=False)
+    stream.set_overlay(a)
+    stream.refill_once()
+    assert {stream.choose(prev_call_id=-1) for _ in range(64)} <= {1, 4}
+    stream.set_overlay(b)
+    assert stream.inventory() == 0          # epoch bump dropped ring
+    draws = {stream.choose(prev_call_id=-1) for _ in range(64)}
+    assert draws <= {2, 6}, draws
+    stream.set_overlay(None)                # back to flat
+    stream.refill_once()
+    flat = {stream.choose(prev_call_id=-1) for _ in range(128)}
+    assert not (flat <= {2, 6})
+
+
+def test_campaign_swap_storm_zero_warm_recompiles(table):
+    """CompileCounter pin: a rotate-through-ALL-shipped-campaigns storm
+    (the manager's rotation path) compiles nothing once warm — overlay
+    operands are fixed (C,) shapes, swaps change contents only
+    (mirrors test_decision_stream's invalidation storm)."""
+    from syzkaller_tpu.vet.runtime import CompileCounter
+
+    eng = CoverageEngine(npcs=NPCS, ncalls=table.count, corpus_cap=16)
+    ovs = []
+    for name in available_campaigns():
+        c = load_campaign(name, table)
+        ovs.append(eng.make_overlay(name, c.boost, c.enabled_ids))
+    stream = DecisionStream(eng, per_row=8, hot_slots=64, corpus_rows=32,
+                            entropy_words=1024, autostart=False)
+    for ov in ovs + [None]:                 # warm every shape once
+        stream.set_overlay(ov)
+        stream.refill_once()
+    with CompileCounter() as cc:
+        for _ in range(3):                  # the storm
+            for ov in ovs + [None]:
+                stream.set_overlay(ov)
+                stream.refill_once()
+                stream.choose(prev_call_id=-1)
+    assert cc.count == 0, cc.events
+
+
+# ---------------------------------------------------------------------------
+# per-campaign frontier views
+
+
+def test_frontier_views_merge_to_serial_replay(rng):
+    """Acceptance: per-campaign frontier views merge to EXACTLY the
+    global bitmap a serial un-campaigned replay produces — and the
+    views partition the frontier (each new bit attributed to exactly
+    one campaign).  Exercises both the word-block-sparse absorb path
+    and the dense fallback."""
+    kw = dict(npcs=1 << 14, ncalls=NCALLS, corpus_cap=16,
+              block_words=2, max_touched_blocks=64)
+    steered = CoverageEngine(**kw)
+    serial = CoverageEngine(**kw)
+    tags = ["vnet-tcp", "kvm-guest", "fs-image"]
+    batches = []
+    for i in range(12):
+        if i % 3 == 0:
+            # wide batch: overflows max_touched_blocks → dense fallback
+            idx = rng.integers(0, 1 << 14, size=(8, 64)).astype(np.int32)
+        else:
+            # narrow batch: a few hot blocks → sparse fast path
+            lo = int(rng.integers(0, (1 << 14) - 600))
+            idx = rng.integers(lo, lo + 512, size=(8, 64)).astype(np.int32)
+        valid = rng.random((8, 64)) < 0.9
+        cids = rng.integers(0, NCALLS, size=8).astype(np.int32)
+        batches.append((cids, idx, valid))
+    sparse_seen = dense_seen = 0
+    for i, (cids, idx, valid) in enumerate(batches):
+        res = steered.update_batch_sparse(cids, idx, valid)
+        if res.blocks is None:
+            dense_seen += 1
+        else:
+            sparse_seen += 1
+        steered.frontier_view(tags[i % 3]).absorb(cids, res)
+        serial.update_batch_sparse(cids, idx, valid)
+    assert sparse_seen and dense_seen       # both absorb paths ran
+    views = steered.frontier_views()
+    assert set(views) == set(tags)
+    merged = merge_views(views.values())
+    assert np.array_equal(merged, np.asarray(serial.max_cover))
+    assert np.array_equal(merged, np.asarray(steered.max_cover))
+    # partition: attribution sums exactly (no double counting)
+    total_bits = int(np.unpackbits(merged.view(np.uint8)).sum())
+    assert sum(v.popcount() for v in views.values()) == total_bits
+    assert all(v.popcount() > 0 for v in views.values())
+
+
+def test_device_signal_frontier_attribution():
+    """The fuzzer's DeviceSignal attributes new signal to the active
+    campaign frontier at SUBMIT time (a mid-flight swap can't
+    misattribute) and stops when cleared."""
+    from syzkaller_tpu.fuzzer.device_signal import DeviceSignal
+
+    sig = DeviceSignal(ncalls=NCALLS, npcs=1 << 13, flush_batch=4)
+    va = sig.engine.frontier_view("vnet-tcp")
+    sig.set_frontier(va)
+    sig.check_batch([(1, np.arange(100, 140, dtype=np.uint64))])
+    assert va.popcount() > 0
+    before = va.popcount()
+    sig.set_frontier(None)
+    sig.check_batch([(2, np.arange(500, 540, dtype=np.uint64))])
+    assert va.popcount() == before
+
+
+# ---------------------------------------------------------------------------
+# protocol depth: the vnet-tcp acceptance
+
+
+def test_vnet_tcp_reaches_states_flat_soup_does_not(table):
+    """Deterministic acceptance: under EQUAL program budget and the
+    SAME enabled set + boosted choice table, the vnet-tcp campaign's
+    stateful generator walks the TCP machine into deep states
+    (ESTABLISHED and the teardown half) that flat soup never reaches —
+    tracked in the new transition-coverage word-block-sparse view."""
+    camp = load_campaign("vnet-tcp", table)
+    machine = camp.machine
+    n_progs = 20
+
+    camp_rand = P.Rand(np.random.default_rng(7))
+    camp_cov = camp.transition_coverage()
+    camp_states: set = set()
+    for _ in range(n_progs):
+        p = camp.generate(camp_rand, 30)
+        w = camp_cov.observe(p.calls)
+        camp_states.update(w.states)
+
+    flat_rand = P.Rand(np.random.default_rng(7))
+    ct = camp.host_choice_table(P.calculate_priorities(table),
+                                camp.enabled_ids)
+    flat_cov = camp.transition_coverage()
+    flat_states: set = set()
+    for _ in range(n_progs):
+        p = P.generate(flat_rand, table, 30, ct)
+        w = flat_cov.observe(p.calls)
+        flat_states.update(w.states)
+
+    deep = {"ESTABLISHED", "FIN_WAIT", "CLOSING", "CLOSED"}
+    assert deep <= camp_states, camp_states
+    assert not (deep & flat_states), flat_states
+    # the transition-coverage view records the gap: campaign bits are a
+    # strict superset with all 10 transitions lit
+    assert camp_cov.covered() == set(range(machine.n_transitions))
+    assert flat_cov.covered() < camp_cov.covered()
+
+
+def test_sequence_mutator_respects_protocol_order(table):
+    """mutate_sequence only deepens, repairs, or trims the protocol
+    walk — after any number of mutations the program's transition
+    sequence is still a valid machine path from the initial state."""
+    camp = load_campaign("vnet-tcp", table)
+    machine = camp.machine
+    rand = P.Rand(np.random.default_rng(11))
+    valid_next = {}
+    for t in machine.transitions:
+        valid_next.setdefault(t.src, set()).add(t.tid)
+    by_id = {t.tid: t for t in machine.transitions}
+    for _ in range(15):
+        p = camp.generate(rand, 30)
+        for _ in range(3):
+            camp.mutate(p, rand, 30)
+            st = machine.initial
+            for tid in machine.walk(p.calls).transitions:
+                assert tid in valid_next.get(st, set()), \
+                    f"transition {tid} invalid from {st}"
+                st = by_id[tid].dst
+
+
+# ---------------------------------------------------------------------------
+# scheduler
+
+
+def test_scheduler_assign_rotate_persist(tmp_path):
+    from syzkaller_tpu import telemetry
+
+    now = [0.0]
+    reg = telemetry.Registry()
+    sched = CampaignScheduler(["vnet-tcp", "kvm-guest", "fs-image"],
+                              rotation=5.0, min_execs=100, tau=30.0,
+                              registry=reg, now=lambda: now[0])
+    # round-robin assignment, sticky per connection
+    assert sched.assign("vm0") == "vnet-tcp"
+    assert sched.assign("vm1") == "kvm-guest"
+    assert sched.assign("vm0") == "vnet-tcp"
+    assert sched.assign("vm2") == "fs-image"
+    # productive campaign: high cov per exec → no rotation
+    for _ in range(10):
+        now[0] += 1.0
+        sched.note_execs("vm0", 50)
+        sched.note_new_cov("vm0", 20, sig_hex="aa")
+    assert sched.new_cov_per_1k_exec("vnet-tcp") > 100.0
+    assert sched.maybe_rotate("vm0") is None
+    # decay: execs keep flowing, cov dries up → rotate
+    for _ in range(150):
+        now[0] += 1.0
+        sched.note_execs("vm0", 50)
+    assert sched.new_cov_per_1k_exec("vnet-tcp") < 5.0
+    assert sched.maybe_rotate("vm0") == "kvm-guest"
+    assert sched.current("vm0") == "kvm-guest"
+    assert sched.stat_rotations == 1
+    # the gauge family carries global + per-campaign labels
+    snap = reg.snapshot()
+    fam = snap["syz_new_cov_per_1k_exec"]
+    assert set(fam) == {"campaign=all", "campaign=vnet-tcp",
+                        "campaign=kvm-guest", "campaign=fs-image"}
+    assert snap["syz_campaign_rotations_total"] == 1
+    # corpus tags persist + restore
+    sched.persist(str(tmp_path))
+    sched2 = CampaignScheduler(["vnet-tcp", "kvm-guest", "fs-image"])
+    sched2.restore(str(tmp_path))
+    assert sched2.tags("vnet-tcp") == ["aa"] * 10
+    assert os.path.exists(os.path.join(str(tmp_path), "campaigns.json"))
+
+
+def test_scheduler_flat_mode():
+    sched = CampaignScheduler([])
+    assert sched.assign("vm0") is None
+    sched.note_execs("vm0", 10)          # global accounting still works
+    sched.note_new_cov("vm0", 5)
+    assert sched.maybe_rotate("vm0") is None
+    assert sched.new_cov_per_1k_exec() >= 0.0
+
+
+# ---------------------------------------------------------------------------
+# manager integration
+
+
+def test_manager_campaign_plane(table):
+    """End to end through the manager: Connect assigns a campaign,
+    Poll serves steered choices from the campaign's own decision
+    stream, admissions attribute new-cov bits + corpus tags to the
+    submitting connection's campaign, rotation rides the Poll
+    response, and the gauge family lands in /metrics text."""
+    from syzkaller_tpu import rpc as rpc_mod
+    from syzkaller_tpu.manager.config import Config
+    from syzkaller_tpu.manager.manager import Manager
+
+    wd = tempfile.mkdtemp(prefix="syz-test-camp-")
+    cfg = Config(workdir=wd, type="local", count=1, procs=1,
+                 descriptions="all", npcs=1 << 13, http="",
+                 admit_batch=0, telemetry=True,
+                 campaigns=["vnet-tcp", "kvm-guest"],
+                 campaign_rotation=1000.0, campaign_min_execs=0)
+    cfg.validate()
+    mgr = Manager(cfg, table=table)
+    try:
+        r = mgr.rpc_connect({"name": "vmA"})
+        assert r["campaign"] == "vnet-tcp"
+        camp = load_campaign("vnet-tcp", table)
+        enabled = set(camp.enabled_ids)
+        r = mgr.rpc_poll({"name": "vmA", "stats": {}})
+        assert r["campaign"] in ("vnet-tcp", "kvm-guest")
+        assert len(r["choices"]) == 64
+        assert set(r["choices"]) <= enabled | \
+            set(load_campaign("kvm-guest", table).enabled_ids)
+        # admission attributes bits + tag to vmA's campaign
+        camp_now = mgr.campaign_sched.current("vmA")
+        data = b"getpid()"
+        mgr.rpc_new_input({
+            "name": "vmA", "prog": rpc_mod.b64(data), "call": "mmap",
+            "call_index": 0, "cover": list(range(100, 150))})
+        import hashlib
+        sig_hex = hashlib.sha1(data).digest().hex()
+        assert sig_hex in mgr.campaign_sched.tags(camp_now)
+        assert mgr.campaign_sched.new_cov_per_1k_exec(camp_now) >= 0.0
+        # rotation: threshold is huge + floor is 0, so execs force it
+        before = mgr.campaign_sched.current("vmA")
+        mgr.rpc_poll({"name": "vmA", "stats": {"exec total": 500}})
+        r = mgr.rpc_poll({"name": "vmA", "stats": {"exec total": 500}})
+        assert mgr.campaign_sched.stat_rotations >= 1
+        assert r["campaign"] != before or \
+            mgr.campaign_sched.stat_rotations >= 1
+        # /metrics carries the gauge family + rotation counter
+        text = mgr.metrics_text()
+        assert "syz_new_cov_per_1k_exec" in text
+        assert 'campaign="vnet-tcp"' in text
+        assert "syz_campaign_rotations_total" in text
+        # campaigns.json persists on stop
+    finally:
+        mgr.stop()
+    assert os.path.exists(os.path.join(wd, "campaigns.json"))
