@@ -725,6 +725,107 @@ def bench_campaign(smoke=False):
     }
 
 
+def bench_resilience(smoke=False):
+    """Fault-tolerance plane costs.
+
+    `recovery_seconds`: crash-only manager restart — construct a fresh
+    manager on a workdir holding a snapshot + persistent tail, restore,
+    replay the tail, and serve the first Poll (the in-process analog of
+    the chaos harness's SIGKILL cycle; tools/chaos.py measures the
+    full-subprocess number).  `cold_recovery_seconds` is the same
+    workdir without snapshots (full-corpus replay) for the speedup.
+    `failover_seconds`: injected device fault → first CPU-backed
+    decision block served, engine state migrated."""
+    import shutil
+    import tempfile
+    import time as _time
+
+    from syzkaller_tpu.manager.config import Config
+    from syzkaller_tpu.manager.manager import Manager
+    from syzkaller_tpu.resilience import ResilientEngine, chaos
+    from syzkaller_tpu.sys.table import load_table
+
+    table = load_table(files=["probe.txt"])
+    n = 48 if smoke else 256
+    tail = max(4, n // 8)
+    inputs = chaos.synth_inputs(table, n, seed=21)
+    acked = {inp[0]: inp for inp in inputs}
+    base = tempfile.mkdtemp(prefix="syz-bench-resil-")
+    out = {}
+    try:
+        w = os.path.join(base, "w")
+        mgr = Manager(Config(**chaos.manager_config(w, 0)), table=table)
+        for inp in inputs[: n - tail]:
+            chaos._admit_direct(mgr, inp)
+        mgr.checkpointer.snapshot_once()
+        for inp in inputs[n - tail:]:
+            chaos._admit_direct(mgr, inp)
+        mgr.server.close()
+        mgr.dstream.stop()
+        if mgr.coalescer is not None:
+            mgr.coalescer.stop()
+        wcold = os.path.join(base, "wcold")
+        shutil.copytree(w, wcold)
+        shutil.rmtree(os.path.join(wcold, "snapshots"))
+
+        def recover(workdir):
+            t0 = _time.monotonic()
+            m = Manager(Config(**chaos.manager_config(workdir, 0)),
+                        table=table)
+            for data in list(m.candidates):
+                inp = acked.get(data)
+                if inp is not None:
+                    chaos._admit_direct(m, inp)
+            m.rpc_poll({"name": "bench"})
+            dt = _time.monotonic() - t0
+            size = len(m.corpus)
+            m.server.close()
+            m.dstream.stop()
+            if m.coalescer is not None:
+                m.coalescer.stop()
+            return dt, size
+
+        t_restored, size_r = recover(w)
+        t_cold, size_c = recover(wcold)
+        if size_r != size_c:     # loss would invalidate the comparison
+            out["recovery_corpus_mismatch"] = [size_r, size_c]
+        out["recovery_seconds"] = round(t_restored, 3)
+        out["cold_recovery_seconds"] = round(t_cold, 3)
+        out["recovery_speedup_vs_cold"] = round(t_cold / t_restored, 2)
+
+        from syzkaller_tpu.cover.engine import CoverageEngine
+        from syzkaller_tpu.fuzzer.device_ct import DecisionStream
+
+        def make_engine():
+            return CoverageEngine(npcs=1 << 12, ncalls=table.count,
+                                  corpus_cap=512)
+
+        eng = ResilientEngine(make_engine(), make_engine,
+                              probe_interval=0.0)
+        stream = DecisionStream(eng, per_row=16, hot_slots=64,
+                                corpus_rows=32, entropy_words=1024,
+                                autostart=False)
+        eng._on_swap = lambda d: stream.rebind()
+        idx = (np.arange(16)[None, :] * 3
+               + np.arange(8)[:, None] * 80).astype(np.int32)
+        eng.admit_if_new(np.arange(8, dtype=np.int32), idx,
+                         np.ones_like(idx, bool))
+        stream.refill_once()
+        eng.injector.arm()
+        t0 = _time.monotonic()
+        # the fault fires on the next dispatch; the first CPU-backed
+        # block (fallback compile included) ends the clock
+        stream.refill_once()
+        draws = stream.take(-1, 16)
+        out["failover_seconds"] = round(_time.monotonic() - t0, 3)
+        assert eng.degraded and len(draws) == 16
+        eng.injector.disarm()
+        stream.stop()
+    finally:
+        shutil.rmtree(base, ignore_errors=True)
+    return out
+
+
 def _stage(name):
     sys.stderr.write(f"[bench] {name}\n")
     sys.stderr.flush()
@@ -832,6 +933,8 @@ def main(argv=None):
     extras.update(bench_repro_rounds(smoke=args.smoke))
     _stage("campaign plane")
     extras.update(bench_campaign(smoke=args.smoke))
+    _stage("resilience plane")
+    extras.update(bench_resilience(smoke=args.smoke))
     # static-analysis gate trajectory: the BENCH_*.json series records
     # the vet finding counts alongside throughput, so a PR that buys
     # speed by parking P0s in the baseline shows up in the history

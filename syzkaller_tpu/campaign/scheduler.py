@@ -184,6 +184,53 @@ class CampaignScheduler:
                  conn, camp, nxt, self.rotation)
         return nxt
 
+    # -- snapshot/restore (resilience plane) -------------------------------
+
+    def export_state(self) -> dict:
+        """JSON-ready scheduler state for the crash-only snapshot:
+        per-campaign EWMA rates + lifetime totals, corpus tags, and the
+        rotation count.  Connection assignments are deliberately NOT
+        exported — after a crash the fleet reconnects and is assigned
+        fresh."""
+        now = self._now()
+        with self._mu:
+            rates = {
+                name: {
+                    "execs_rate": r.execs.rate(now),
+                    "cov_rate": r.cov.rate(now),
+                    "exec_total": r.exec_total,
+                    "cov_total": r.cov_total,
+                } for name, r in self._rates.items()}
+            return {
+                "rates": rates,
+                "tags": {c: list(v) for c, v in self._tags.items()},
+                "rotations": self.stat_rotations,
+            }
+
+    def import_state(self, state: dict) -> None:
+        """Restore an `export_state` cut: known campaigns' EWMAs resume
+        from their snapshotted rates (decaying normally), tags merge,
+        and unknown campaigns (config changed across the restart) are
+        skipped."""
+        if not state:
+            return
+        now = self._now()
+        with self._mu:
+            for name, d in (state.get("rates") or {}).items():
+                r = self._rates.get(name)
+                if r is None:
+                    continue
+                r.exec_total = int(d.get("exec_total", 0))
+                r.cov_total = int(d.get("cov_total", 0))
+                r.execs.seed(float(d.get("execs_rate", 0.0)), now=now)
+                r.cov.seed(float(d.get("cov_rate", 0.0)), now=now)
+            for c, sigs in (state.get("tags") or {}).items():
+                if c in self._tags:
+                    merged = dict.fromkeys(list(self._tags[c]) + list(sigs))
+                    self._tags[c] = list(merged)
+            self.stat_rotations = max(self.stat_rotations,
+                                      int(state.get("rotations", 0)))
+
     # -- persistence -------------------------------------------------------
 
     def persist(self, workdir: str) -> None:

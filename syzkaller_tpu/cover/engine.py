@@ -586,6 +586,23 @@ class SparseView:
             stack = np.stack(list(self._blocks.values()))
         return int(np.unpackbits(stack.view(np.uint8)).sum())
 
+    def export_blocks(self) -> "tuple[np.ndarray, np.ndarray]":
+        """Snapshot serialization: sorted touched-block ids plus their
+        (ncalls, block_words) slabs stacked along axis 0."""
+        with self._mu:
+            ids = np.array(sorted(self._blocks), np.int64)
+            data = (np.stack([self._blocks[int(b)] for b in ids])
+                    if len(ids) else
+                    np.zeros((0, self.ncalls, self.block_words), np.uint32))
+        return ids, data
+
+    def import_blocks(self, ids, data) -> None:
+        """OR a serialized block set back in (restore path)."""
+        with self._mu:
+            for b, blk in zip(np.asarray(ids, np.int64),
+                              np.asarray(data, np.uint32)):
+                self._block(int(b))[:] |= blk
+
     def touched_block_count(self) -> int:
         with self._mu:
             return len(self._blocks)
@@ -1514,3 +1531,76 @@ class CoverageEngine:
 
     def max_cover_pcs(self, call_id: int) -> np.ndarray:
         return self.cover_pcs(call_id, corpus=False)
+
+    # -- state migration (checkpoint / backend failover) -----------------
+
+    @_locked
+    def export_state(self) -> dict:
+        """Host-side copy of every piece of engine state another engine
+        (or a snapshot) needs to continue bit-exactly: the coverage
+        bitmaps, the admitted corpus matrix rows, and the priority/
+        choice-table operands.  Runs under the state lock so the copy
+        is a consistent point-in-time cut; the arrays are plain numpy
+        (no device references escape)."""
+        n = self.corpus_len
+        return {
+            "npcs": self.npcs, "ncalls": self.ncalls, "W": self.W,
+            "corpus_len": n,
+            "max_cover": np.asarray(self.max_cover),
+            "corpus_cover": np.asarray(self.corpus_cover),
+            "flakes": np.asarray(self.flakes),
+            # full fetch + HOST slice: a device-side [:n] slice would
+            # compile a new kernel per corpus length (one per
+            # snapshot/failover — a slow retrace treadmill)
+            "corpus_mat": np.asarray(self.corpus_mat)[:n].copy(),
+            "corpus_call": self.corpus_call[:n].copy(),
+            "prios": np.asarray(self.prios),
+            "enabled": np.asarray(self.enabled),
+        }
+
+    @_locked
+    def import_state(self, state: dict) -> None:
+        """Install an `export_state` cut into THIS engine (same npcs/
+        ncalls config required; corpus must fit this engine's cap).
+        Device placement follows this engine's mesh, so a CPU-backed
+        failover engine and the original device engine exchange state
+        through the same dict."""
+        for k in ("npcs", "ncalls", "W"):
+            if int(state[k]) != getattr(self, k):
+                raise ValueError(
+                    f"engine state mismatch: {k}={state[k]} != "
+                    f"{getattr(self, k)}")
+        n = int(state["corpus_len"])
+        if n > self.cap:
+            raise ValueError(f"corpus_len {n} > cap {self.cap}")
+        row = (NamedSharding(self.mesh, P(None, "pc"))
+               if self.mesh is not None else None)
+        rep = NamedSharding(self.mesh, P()) if self.mesh is not None else None
+
+        def put(arr, sharding):
+            a = jnp.asarray(arr)
+            return jax.device_put(a, sharding) if sharding is not None else a
+
+        self.max_cover = put(np.asarray(state["max_cover"], np.uint32), row)
+        self.corpus_cover = put(np.asarray(state["corpus_cover"],
+                                           np.uint32), row)
+        self.flakes = put(np.asarray(state["flakes"], np.uint32), row)
+        mat = np.zeros((self.cap, self.W), np.uint32)
+        mat[:n] = np.asarray(state["corpus_mat"], np.uint32)
+        self.corpus_mat = put(mat, row)
+        self.corpus_call = np.zeros((self.cap,), np.int32)
+        self.corpus_call[:n] = np.asarray(state["corpus_call"], np.int32)
+        self.corpus_len = n
+        self.prios = put(np.asarray(state["prios"], np.float32), rep)
+        self.enabled = put(np.asarray(state["enabled"], bool), rep)
+        # pre-drawn decision state conditioned on the old arrays is
+        # stale; the stream rebuilds its chain lazily off the main key
+        self._ds_key = None
+
+    def adopt_frontiers(self, views: "dict[str, SparseView]") -> None:
+        """Carry per-campaign frontier views across an engine swap: the
+        views are host-side objects, so adopting them is a dict update
+        — accumulated campaign attribution survives a failover."""
+        with self._frontier_mu:
+            for tag, v in views.items():
+                self._frontiers.setdefault(tag, v)

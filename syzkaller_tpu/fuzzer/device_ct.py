@@ -290,12 +290,38 @@ class DecisionStream:
         with self._mu:
             return self._overlay
 
-    def stop(self) -> None:
+    def rebind(self) -> None:
+        """Re-home cached device operands after a backend swap (the
+        resilience plane's failover/promotion): the hot-prev
+        composition is re-uploaded through the CURRENT engine (the old
+        buffers may live on a dead backend) and every pre-drawn block
+        is invalidated — the eager redraw repopulates from the new
+        backend.  put_replicated runs outside _mu (device work under a
+        lock is a syz-vet P0)."""
+        with self._mu:
+            hot = self._hot_host
+        dev = self.engine.put_replicated(hot)
+        with self._mu:
+            self._hot_dev = dev
+        self.invalidate()
+
+    def stop(self) -> bool:
+        """Stop the prefetcher; idempotent under double-close (the
+        manager's stop path and a failover teardown may both call it).
+        Returns False when the thread failed to join (wedged — the
+        caller logs/counts the leak)."""
         with self._cv:
             self._stop = True
             self._cv.notify_all()
-        if self._thread is not None:
-            self._thread.join(timeout=10.0)
+        t, self._thread = self._thread, None
+        if t is None:
+            return True
+        t.join(timeout=10.0)
+        if t.is_alive():
+            log.logf(0, "decision-stream prefetcher failed to stop "
+                     "(thread leaked)")
+            return False
+        return True
 
     # -- prefetcher --------------------------------------------------------
 

@@ -58,7 +58,6 @@ class Fuzzer:
                  npcs: int = 1 << 16, flush_batch: int = 32,
                  corpus_cap: int = 1 << 14):
         self.name = name
-        self.client = rpc.RpcClient(manager_addr)
         self.procs = procs
         self.output_mode = output_mode
         self.table = table or load_table(
@@ -73,6 +72,18 @@ class Fuzzer:
         # its html view are byte-identical
         self.registry = telemetry.Registry()
         self.tracer = telemetry.Tracer(name=name)
+        # RPC fault envelope: a mid-call socket break reconnects and
+        # retries with backoff inside the client (counted) instead of
+        # killing the proc loop — the manager dedups replayed NewInputs
+        # by idempotency key
+        self._c_rpc_retries = self.registry.counter(
+            "syz_rpc_retries_total",
+            "RPC attempts retried after a transport fault")
+        self._c_rpc_failures = self.registry.counter(
+            "syz_rpc_failures_total",
+            "RPC calls abandoned after exhausting retries")
+        self.client = rpc.RpcClient(manager_addr,
+                                    retry_counter=self._c_rpc_retries)
         self._ts_shipped = None          # poll-delta watermark for the
         #                                  device stat vector (if any)
         f_exec = self.registry.counter(
@@ -449,13 +460,20 @@ class Fuzzer:
                                          corpus_index=len(self.corpus) - 1)
         self._stat_counters["new inputs"].inc()
         span.add_hop("fuzzer:triage+minimize", time.monotonic() - t_triage)
-        self.client.call("Manager.NewInput", {
-            "name": self.name,
-            "call": item.prog.calls[item.call_index].meta.name,
-            "prog": rpc.b64(data),
-            "call_index": item.call_index,
-            "cover": [int(x) for x in min_cover],
-        }, span=span)
+        try:
+            self.client.call("Manager.NewInput", {
+                "name": self.name,
+                "call": item.prog.calls[item.call_index].meta.name,
+                "prog": rpc.b64(data),
+                "call_index": item.call_index,
+                "cover": [int(x) for x in min_cover],
+            }, span=span)
+        except (rpc.RpcError, OSError, ConnectionError) as e:
+            # the client already retried with backoff; a manager still
+            # down must not kill this proc loop — the input stays in
+            # the local corpus and fuzzing continues
+            self._c_rpc_failures.inc()
+            log.logf(0, "NewInput delivery failed after retries: %s", e)
 
     def minimize_input(self, env: ipc.Env, item: TriageItem,
                        stable_new: np.ndarray, pid: int
@@ -751,11 +769,25 @@ class Fuzzer:
                     log.logf(0, "poll failed: %s", e)
         finally:
             self._stop = True
+            leaked = 0
             for t in threads:
+                # join with a bound, but don't silently abandon a
+                # wedged proc thread — log + count the leak so fleet
+                # health shows it instead of a quiet fd/memory drip
                 t.join(timeout=5.0)
+                if t.is_alive():
+                    leaked += 1
+            if leaked:
+                self.registry.counter(
+                    "syz_thread_leak_total",
+                    "shutdown joins that abandoned a wedged thread",
+                    labels=("thread",)).labels(thread="proc-loop").inc(
+                        leaked)
+                log.logf(0, "shutdown leaked %d wedged proc thread(s)",
+                         leaked)
             self.flush_signal(force=True)
             if self.ct is not None and hasattr(self.ct, "stop"):
-                self.ct.stop()          # decision-stream prefetcher
+                self.ct.stop()   # decision-stream prefetcher (idempotent)
 
     def stop(self) -> None:
         self._stop = True

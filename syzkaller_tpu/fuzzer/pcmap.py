@@ -159,6 +159,16 @@ class PcMap:
         out = self.map_flat(pcs)
         return int((out >= self.direct_cap).sum())
 
+    def export_keys(self) -> np.ndarray:
+        """Direct-mapped PCs in first-seen order — the whole mapping
+        state: `preseed`ing these into a fresh map reassigns the exact
+        same dense indices (vals are sequential in insertion order,
+        overflow hashing is stateless).  The resilience snapshot
+        carries this so restored coverage bitmaps keep meaning the same
+        PCs."""
+        with self._mu:
+            return self._rev[:self._n].copy()
+
     def index_of(self, pc: int) -> int:
         return int(self.map_flat(np.array([pc], np.uint64))[0])
 

@@ -111,16 +111,27 @@ class AdmissionCoalescer:
                 out.append(self._choices.popleft())
         return out
 
-    def stop(self) -> None:
+    def stop(self) -> bool:
+        """Stop the drainer; idempotent under double-close.  Returns
+        False when the drainer thread failed to join (wedged mid-batch
+        — the manager counts the leak instead of hanging shutdown)."""
         with self._cv:
             self._stop = True
             self._cv.notify_all()
-        self._thread.join(timeout=10.0)
+        t, self._thread = self._thread, None
+        joined = True
+        if t is not None:
+            t.join(timeout=10.0)
+            if t.is_alive():
+                log.logf(0, "admission coalescer failed to stop "
+                         "(thread leaked)")
+                joined = False
         # unblock anyone still waiting (their entries were drained or
         # the drainer exited before reaching them)
         with self._cv:
             while self._q:
                 self._q.popleft().done.set()
+        return joined
 
     # -- drainer -----------------------------------------------------------
 
@@ -247,15 +258,16 @@ class AdmissionCoalescer:
                 mgr._record_rejected(len(fresh) - len(admitted))
             if admitted:
                 mgr._record_admit_rate(len(admitted))
-        # resolve tickets BEFORE persistence: callers resubmit their
-        # next input while the drainer writes this batch's programs to
-        # disk (persistence stays ordered inside the drainer, lag
-        # bounded by one batch — the reply itself was never transactional
-        # with the disk write)
-        for p in batch:
-            p.done.set()
+        # persistence BEFORE ticket resolution: an acked NewInput must
+        # be durable — the chaos harness SIGKILLs the manager right
+        # after replies land and asserts zero corpus loss, which the
+        # old resolve-then-persist order failed (the ack'd program
+        # existed only in memory for one batch window).  The writes are
+        # batched tmp+rename appends, noise next to the fused dispatch.
         for p, _row in admitted:
             mgr.persistent.add(p.data)
+        for p in batch:
+            p.done.set()
         if admitted:
             mgr._maybe_update_prios()
 

@@ -65,6 +65,20 @@ class Config:
     #                                    (0 = never rotate)
     campaign_min_execs: int = 2000     # rotation arms only after this
     #                                    many execs under the campaign
+    # resilience plane (fault tolerance)
+    snapshot_interval: float = 300.0   # crash-only state snapshot cadence
+    #                                    (workdir/snapshots/; 0 = off —
+    #                                    restart falls back to the cold
+    #                                    full-corpus replay)
+    snapshot_keep: int = 3             # newest snapshots retained
+    backend_failover: bool = True      # wrap the cover engine in the
+    #                                    ResilientEngine supervisor:
+    #                                    device-flap → CPU fallback
+    #                                    mid-run, probe + promote back
+    conn_timeout: float = 120.0        # reap fuzzer connections silent
+    #                                    this long: campaign assignment
+    #                                    and queued inputs return to the
+    #                                    pool (0 = never reap)
     # VM-type specific (qemu)
     kernel: str = ""
     image: str = ""
@@ -110,8 +124,11 @@ class Config:
     def validate(self) -> None:
         from syzkaller_tpu.vm import types as vm_types
 
-        if not 1 <= self.count <= 1000:   # ref config.go:137-138
-            raise ConfigError(f"invalid count {self.count} (1..1000)")
+        # count=0 = no managed VMs: external fuzzers attach over RPC
+        # (the chaos harness and hub-only deployments); ref
+        # config.go:137-138 caps the top end
+        if not 0 <= self.count <= 1000:
+            raise ConfigError(f"invalid count {self.count} (0..1000)")
         if not 1 <= self.procs <= 32:     # ref config.go:147-151
             raise ConfigError(f"invalid procs {self.procs} (1..32)")
         if self.type not in vm_types():
@@ -161,6 +178,15 @@ class Config:
         if self.campaign_min_execs < 0:
             raise ConfigError(
                 f"invalid campaign_min_execs {self.campaign_min_execs}")
+        if self.snapshot_interval < 0:
+            raise ConfigError(
+                f"invalid snapshot_interval {self.snapshot_interval}")
+        if self.snapshot_keep < 1:
+            raise ConfigError(
+                f"invalid snapshot_keep {self.snapshot_keep} (>= 1)")
+        if self.conn_timeout < 0:
+            raise ConfigError(
+                f"invalid conn_timeout {self.conn_timeout}")
         # NOTE: device availability for `mesh` is checked when the
         # manager builds the engine (cover.engine.pc_mesh raises) —
         # config linting must not initialize an accelerator runtime.

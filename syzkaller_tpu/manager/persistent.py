@@ -3,6 +3,13 @@
 Capability parity with reference syz-manager/persistent.go:15-102:
 verify-on-load (stale programs that no longer parse are garbage
 collected), content-hash naming, add, and minimize-to-subset.
+
+Crash-only hardening: writes go through a unique temp file + rename
+(two managers or a crash mid-write can never leave a half-written
+entry under its final name), orphaned temp files from a crashed writer
+are swept on load, and an unreadable/corrupt entry is skipped and
+counted (`syz_corpus_load_corrupt_total`) instead of aborting manager
+startup — losing one program beats losing the whole corpus.
 """
 
 from __future__ import annotations
@@ -10,7 +17,7 @@ from __future__ import annotations
 import hashlib
 import os
 
-from syzkaller_tpu.utils import log
+from syzkaller_tpu.utils import fileutil, log
 
 
 def _sig(data: bytes) -> str:
@@ -18,9 +25,13 @@ def _sig(data: bytes) -> str:
 
 
 class PersistentSet:
-    def __init__(self, dirpath: str, verify=None):
-        """verify: fn(data) -> bool; failing entries are deleted."""
+    def __init__(self, dirpath: str, verify=None, corrupt_counter=None,
+                 persist_err_counter=None):
+        """verify: fn(data) -> bool; failing entries are deleted.
+        corrupt_counter / persist_err_counter: optional telemetry
+        Counters for load-time corruption and write failures."""
         self.dir = dirpath
+        self._c_persist_err = persist_err_counter
         os.makedirs(dirpath, exist_ok=True)
         self.entries: dict[str, bytes] = {}
         bad = 0
@@ -28,15 +39,31 @@ class PersistentSet:
             path = os.path.join(dirpath, name)
             if not os.path.isfile(path):
                 continue
-            with open(path, "rb") as f:
-                data = f.read()
+            if name.startswith("."):
+                # orphaned temp file from a writer that died mid-write
+                try:
+                    os.unlink(path)
+                except OSError:
+                    pass
+                continue
+            try:
+                with open(path, "rb") as f:
+                    data = f.read()
+            except OSError:
+                bad += 1         # unreadable: skip, don't abort startup
+                continue
             if _sig(data) != name or (verify is not None and not verify(data)):
                 bad += 1
-                os.unlink(path)
+                try:
+                    os.unlink(path)
+                except OSError:
+                    pass
                 continue
             self.entries[name] = data
         if bad:
-            log.logf(0, "corpus: removed %d broken/stale programs", bad)
+            log.logf(0, "corpus: skipped %d broken/stale programs", bad)
+            if corrupt_counter is not None:
+                corrupt_counter.inc(bad)
 
     def __len__(self) -> int:
         return len(self.entries)
@@ -52,10 +79,19 @@ class PersistentSet:
         if sig in self.entries:
             return False
         self.entries[sig] = data
-        tmp = os.path.join(self.dir, f".tmp.{sig}")
-        with open(tmp, "wb") as f:
-            f.write(data)
-        os.replace(tmp, os.path.join(self.dir, sig))
+        try:
+            # unique temp + rename (fileutil.write_file): concurrent
+            # writers of the same sig race benignly — both temp files
+            # hold identical bytes, the last rename wins
+            fileutil.write_file(os.path.join(self.dir, sig), data)
+        except OSError as e:
+            # disk trouble must not kill the admission plane; the
+            # program stays in memory and the snapshot/restore path
+            # counts it as tail loss if the manager dies before a
+            # successful re-add
+            log.logf(0, "corpus persist failed for %s: %s", sig[:12], e)
+            if self._c_persist_err is not None:
+                self._c_persist_err.inc()
         return True
 
     def minimize(self, keep: "list[bytes]") -> int:

@@ -122,6 +122,24 @@ print("telemetry ok: %d series" % len(series))
         if r.returncode != 0:
             raise SystemExit("telemetry smoke failed")
 
+    def chaos_smoke():
+        # one SIGKILL/restore cycle against a real manager subprocess:
+        # mid-admission-storm kill, snapshot restore + tail replay,
+        # frontier proven bit-exact vs a never-crashed serial run
+        import json
+
+        r = subprocess.run(
+            [sys.executable, "tools/chaos.py", "--smoke"],
+            cwd=root, env=env, capture_output=True, text=True,
+            timeout=600)
+        if r.returncode != 0:
+            sys.stderr.write(r.stdout[-2000:] + r.stderr[-2000:])
+            raise SystemExit(f"chaos smoke failed ({r.returncode})")
+        out = json.loads(r.stdout.strip().splitlines()[-1])
+        assert out["frontier_bit_exact"] and out["corpus_lost"] == 0, out
+        print(f"[presubmit]   recovery {out['recovery_seconds']}s, "
+              f"corpus {out['corpus_size']}, 0 lost")
+
     def bench_smoke():
         # seconds-scale CPU-only bench pass on tiny shapes: catches
         # bench.py import/shape regressions here instead of in the next
@@ -144,6 +162,7 @@ print("telemetry ok: %d series" % len(series))
     total += step("native executor build", build_executor)
     total += step("engine + multichip smoke", engine_smoke)
     total += step("telemetry smoke", telemetry_smoke)
+    total += step("chaos smoke (kill/restore cycle)", chaos_smoke)
     total += step("bench smoke", bench_smoke)
     total += step("pytest", pytest_run)
     print(f"[presubmit] PASS in {total:.0f}s")

@@ -1,0 +1,34 @@
+"""Fault-tolerance plane: crash-only snapshot/restore, device-flap
+failover, and the chaos harness.
+
+The reference syzkaller is built to survive its own workload — kernels
+crash, VMs die, managers restart.  This package gives the reproduction
+the same property around its device-resident state:
+
+- checkpoint: `Checkpointer` periodically serializes the admitted-
+  corpus frontier (word-block-sparse bitmaps + max cover), the
+  priority/choice-table operands, per-campaign frontier views and
+  scheduler EWMAs, and the triage cluster index into atomic,
+  versioned, checksummed snapshots under workdir/snapshots/.  Manager
+  startup restores the newest valid snapshot and replays only the
+  persistent-corpus tail admitted after it.
+- supervisor: `ResilientEngine` wraps the cover engine behind the
+  same seams, quarantines the backend on dispatch faults, migrates
+  engine state to a CPU-backed fallback, keeps fuzzing (degraded,
+  `syz_backend_degraded` gauge), and probes for recovery with
+  promotion back.
+- chaos: a live-fleet harness that kills fuzzer procs, severs RPC
+  sockets mid-Poll, SIGKILLs the manager mid-admission, and
+  fault-injects device dispatches, asserting zero corpus loss and
+  bounded recovery (tools/chaos.py is the CLI).
+"""
+
+from syzkaller_tpu.resilience.checkpoint import (
+    Checkpointer, SnapshotError, load_latest_snapshot)
+from syzkaller_tpu.resilience.supervisor import (
+    FaultInjector, InjectedFault, ResilientEngine)
+
+__all__ = [
+    "Checkpointer", "FaultInjector", "InjectedFault", "ResilientEngine",
+    "SnapshotError", "load_latest_snapshot",
+]

@@ -1,0 +1,451 @@
+"""Chaos harness: run a live local fleet and break it on purpose.
+
+The scenarios mirror how this system actually dies in production:
+fuzzer processes are SIGKILLed, RPC sockets are severed mid-Poll, the
+manager is SIGKILLed mid-admission-storm, and device dispatches are
+fault-injected — after each, the harness asserts ZERO corpus loss,
+frontier equivalence to a never-crashed serial replay of the same
+admitted inputs, and bounded recovery time.
+
+The pieces are importable (tests/test_chaos.py drives them in-process
+and hermetically); tools/chaos.py is the CLI front-end
+(`python tools/chaos.py --smoke` = presubmit's single kill/restore
+cycle).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import signal
+import socket
+import subprocess
+import sys
+import threading
+import time
+
+import numpy as np
+
+
+# -- deterministic synthetic workload ---------------------------------------
+
+
+def synth_inputs(table, n: int, seed: int = 0, pcs_per_input: int = 24):
+    """n deterministic (data, call, call_index, cover) tuples: real
+    serializable programs (the manager's verify-on-load must accept
+    them) with covers derived from the program hash — replayable
+    bit-for-bit by any driver that holds the same list."""
+    from syzkaller_tpu import prog as P
+
+    rand = P.Rand(np.random.default_rng(seed))
+    prios = P.calculate_priorities(table)
+    ct = P.ChoiceTable(prios, {c.id for c in table.calls},
+                       ncalls=table.count)
+    out = []
+    seen = set()
+    while len(out) < n:
+        p = P.generate(rand, table, 6, ct)
+        data = P.serialize(p)
+        if data in seen or not p.calls:
+            continue
+        seen.add(data)
+        h = hashlib.sha1(data).digest()
+        base = int.from_bytes(h[:8], "little")
+        stride = 1 + (int.from_bytes(h[8:10], "little") | 1)
+        cover = [(base + i * stride) & 0xFFFFFFFFFFFF
+                 for i in range(pcs_per_input)]
+        out.append((data, p.calls[0].meta.name, 0, cover))
+    return out
+
+
+# -- an RPC-driven pseudo-fuzzer --------------------------------------------
+
+
+class FleetDriver:
+    """Acts as a fuzzer over the manager's RPC plane: Connect, NewInput
+    storms, candidate pull + replay.  Records every acked program and
+    its cover so a post-crash replay is exact."""
+
+    def __init__(self, addr, name: str = "chaos0", retries: int = 4):
+        from syzkaller_tpu import rpc
+
+        self.rpc = rpc
+        self.name = name
+        self.client = rpc.RpcClient(addr, timeout=30.0, retries=retries)
+        self.acked: "dict[bytes, tuple]" = {}     # reply arrived
+        self.sent: "dict[bytes, tuple]" = {}      # request issued (a
+        #                                           crash may have eaten
+        #                                           the reply, not the
+        #                                           admission)
+        self.cover_of: "dict[bytes, list]" = {}   # data -> cover
+        self.candidates: "list[bytes]" = []
+
+    def connect(self) -> dict:
+        r = self.client.call("Manager.Connect", {"name": self.name})
+        self._take_candidates(r)
+        return r
+
+    def _take_candidates(self, r: dict) -> None:
+        for cp in r.get("candidates", []):
+            self.candidates.append(self.rpc.unb64(cp["prog"]))
+
+    def send(self, inp) -> bool:
+        """One NewInput; True when the manager acked it (the reply
+        arrived — admission or rejection both count as 'durably
+        processed')."""
+        data, call, ci, cover = inp
+        self.cover_of[data] = cover
+        self.sent[data] = inp
+        self.client.call("Manager.NewInput", {
+            "name": self.name, "call": call, "prog": self.rpc.b64(data),
+            "call_index": ci, "cover": cover})
+        self.acked[data] = inp
+        return True
+
+    def storm(self, inputs, stop_on_error: bool = False) -> int:
+        """Send a NewInput burst; returns how many were acked.  A
+        transport failure (manager died mid-storm) stops the burst."""
+        sent = 0
+        for inp in inputs:
+            try:
+                self.send(inp)
+                sent += 1
+            except Exception:
+                if stop_on_error:
+                    break
+                break
+        return sent
+
+    def poll(self, need_candidates: bool = True) -> dict:
+        r = self.client.call("Manager.Poll", {
+            "name": self.name, "stats": {},
+            "need_candidates": need_candidates})
+        self._take_candidates(r)
+        return r
+
+    def drain_candidates(self, rounds: int = 50) -> "list[bytes]":
+        """Pull candidates until the manager stops handing them out."""
+        for _ in range(rounds):
+            before = len(self.candidates)
+            self.poll(need_candidates=True)
+            if len(self.candidates) == before:
+                break
+        return self.candidates
+
+    def replay_candidates(self, lookup=None) -> int:
+        """Re-execute the candidate tail: send each candidate program
+        back as a NewInput with its recorded cover (what a real fuzzer
+        does by re-running the program and reporting KCOV)."""
+        lookup = lookup or self.cover_of
+        n = 0
+        for data in self.candidates:
+            cover = lookup.get(data)
+            inp = self.sent.get(data) or self.acked.get(data)
+            if cover is None or inp is None:
+                continue
+            self.send((data, inp[1], inp[2], cover))
+            n += 1
+        return n
+
+    def close(self) -> None:
+        self.client.close()
+
+
+# -- manager subprocess control ---------------------------------------------
+
+
+def free_port() -> int:
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def repo_root() -> str:
+    return os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+
+
+def manager_config(workdir: str, port: int, **overrides) -> dict:
+    cfg = {
+        "workdir": workdir, "type": "local", "count": 0,
+        "rpc": f"127.0.0.1:{port}", "http": "",
+        "descriptions": "probe.txt", "npcs": 1 << 12,
+        "corpus_cap": 1 << 10, "admit_batch": 8,
+        "snapshot_interval": 0.5, "conn_timeout": 0,
+    }
+    cfg.update(overrides)
+    return cfg
+
+
+def spawn_manager(workdir: str, port: int, log_path: "str | None" = None,
+                  **overrides) -> subprocess.Popen:
+    """Start a manager subprocess on `workdir` serving RPC on `port`
+    (count=0: the chaos driver IS the fleet)."""
+    os.makedirs(workdir, exist_ok=True)
+    cfg_path = os.path.join(workdir, "chaos-manager.json")
+    with open(cfg_path, "w") as f:
+        json.dump(manager_config(workdir, port, **overrides), f)
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PYTHONPATH"] = repo_root() + (
+        os.pathsep + env["PYTHONPATH"] if env.get("PYTHONPATH") else "")
+    logf = open(log_path or os.path.join(workdir, "chaos-manager.log"),
+                "ab")
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "syzkaller_tpu.manager",
+         "-config", cfg_path],
+        cwd=repo_root(), env=env, stdout=logf, stderr=subprocess.STDOUT,
+        start_new_session=True)
+    logf.close()
+    return proc
+
+
+def wait_rpc(port: int, timeout: float = 120.0) -> float:
+    """Block until the manager serves RPC (a Ping round-trips);
+    returns the seconds it took."""
+    from syzkaller_tpu import rpc
+
+    t0 = time.monotonic()
+    deadline = t0 + timeout
+    last = None
+    while time.monotonic() < deadline:
+        try:
+            cli = rpc.RpcClient(("127.0.0.1", port), timeout=5.0,
+                                retries=1)
+            cli.call("Manager.Ping", {"name": "probe"})
+            cli.close()
+            return time.monotonic() - t0
+        except Exception as e:
+            last = e
+            time.sleep(0.1)
+    raise TimeoutError(f"manager rpc :{port} never came up: {last}")
+
+
+def sigkill(proc: subprocess.Popen) -> None:
+    """SIGKILL the manager process group — the crash-only crash."""
+    try:
+        os.killpg(proc.pid, signal.SIGKILL)
+    except (ProcessLookupError, PermissionError):
+        proc.kill()
+    proc.wait(timeout=30)
+
+
+# -- socket chaos -----------------------------------------------------------
+
+
+class ChaosProxy:
+    """TCP middlebox between a client and the manager: forwards bytes
+    until `sever()` hard-closes every live connection (RST-ish) — the
+    'RPC socket dies mid-Poll' scenario without touching either end."""
+
+    def __init__(self, upstream: "tuple[str, int]"):
+        self.upstream = upstream
+        self._lsock = socket.socket()
+        self._lsock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._lsock.bind(("127.0.0.1", 0))
+        self._lsock.listen(16)
+        self.addr = self._lsock.getsockname()
+        self._conns: "list[socket.socket]" = []
+        self._mu = threading.Lock()
+        self._stop = False
+        self.stat_severed = 0
+        self._thread = threading.Thread(target=self._accept_loop,
+                                        daemon=True)
+        self._thread.start()
+
+    def _accept_loop(self) -> None:
+        while not self._stop:
+            try:
+                c, _ = self._lsock.accept()
+            except OSError:
+                return
+            try:
+                u = socket.create_connection(self.upstream, timeout=10.0)
+            except OSError:
+                c.close()
+                continue
+            with self._mu:
+                self._conns += [c, u]
+            threading.Thread(target=self._pump, args=(c, u),
+                             daemon=True).start()
+            threading.Thread(target=self._pump, args=(u, c),
+                             daemon=True).start()
+
+    def _pump(self, src: socket.socket, dst: socket.socket) -> None:
+        try:
+            while True:
+                data = src.recv(1 << 16)
+                if not data:
+                    break
+                dst.sendall(data)
+        except OSError:
+            pass
+        for s in (src, dst):
+            try:
+                s.close()
+            except OSError:
+                pass
+
+    def sever(self) -> int:
+        """Hard-close every live proxied connection."""
+        with self._mu:
+            conns, self._conns = self._conns, []
+        for s in conns:
+            try:
+                s.setsockopt(socket.SOL_SOCKET, socket.SO_LINGER,
+                             b"\x01\x00\x00\x00\x00\x00\x00\x00")
+            except OSError:
+                pass
+            try:
+                s.close()
+            except OSError:
+                pass
+        self.stat_severed += len(conns) // 2
+        return len(conns) // 2
+
+    def close(self) -> None:
+        self._stop = True
+        try:
+            self._lsock.close()
+        except OSError:
+            pass
+        self.sever()
+
+
+# -- the kill/restore cycle (CLI smoke + acceptance) ------------------------
+
+
+def run_kill_restore_cycle(base_dir: str, n_inputs: int = 48,
+                           kill_at: "int | None" = None,
+                           verbose: bool = False) -> dict:
+    """One crash-only cycle against a REAL manager subprocess:
+
+      storm NewInputs → (snapshot lands) → SIGKILL mid-storm →
+      restart → candidates (tail) replayed → verify
+
+    Verification builds two in-process managers: one restoring the
+    crashed workdir (snapshot + tail replay) and one never-crashed
+    serial manager admitting the same acked inputs — their corpus
+    frontiers must be bit-exact and no acked program may be lost.
+    Returns the measurements dict (recovery_seconds, counts)."""
+    from syzkaller_tpu.manager.config import Config
+    from syzkaller_tpu.manager.manager import Manager
+    from syzkaller_tpu.sys.table import load_table
+
+    def say(msg):
+        if verbose:
+            sys.stderr.write(f"[chaos] {msg}\n")
+            sys.stderr.flush()
+
+    table = load_table(files=["probe.txt"])
+    inputs = synth_inputs(table, n_inputs, seed=7)
+    kill_at = kill_at if kill_at is not None else (2 * n_inputs) // 3
+    workdir = os.path.join(base_dir, "w-crash")
+    port = free_port()
+
+    say("spawning manager")
+    proc = spawn_manager(workdir, port)
+    out: dict = {}
+    try:
+        wait_rpc(port)
+        driver = FleetDriver(("127.0.0.1", port))
+        driver.connect()
+        say(f"storming {kill_at} inputs, waiting for a snapshot")
+        assert driver.storm(inputs[:kill_at]) == kill_at
+        snapdir = os.path.join(workdir, "snapshots")
+        deadline = time.monotonic() + 60.0
+        while time.monotonic() < deadline:
+            if os.path.isdir(snapdir) and any(
+                    n.endswith(".ckpt") for n in os.listdir(snapdir)):
+                break
+            time.sleep(0.1)
+        else:
+            raise TimeoutError("no snapshot landed")
+        # SIGKILL mid-admission-storm: a killer thread fires while the
+        # driver is still sending
+        killer = threading.Timer(0.02, sigkill, args=(proc,))
+        killer.start()
+        driver.storm(inputs[kill_at:])
+        killer.join()
+        say(f"killed mid-storm; {len(driver.acked)} inputs acked")
+        out["acked_before_kill"] = len(driver.acked)
+
+        say("restarting manager (crash-only restore)")
+        t0 = time.monotonic()
+        proc = spawn_manager(workdir, port)
+        wait_rpc(port)
+        driver2 = FleetDriver(("127.0.0.1", port), name="chaos0")
+        driver2.connect()
+        driver2.poll()          # frontier restored AND serving Poll
+        out["recovery_seconds"] = round(time.monotonic() - t0, 3)
+        # the tail: candidates the snapshot predates — replay them,
+        # plus anything the dead manager never acked
+        driver2.cover_of = driver.cover_of
+        driver2.acked = dict(driver.acked)
+        driver2.sent = dict(driver.sent)
+        driver2.drain_candidates()
+        out["tail_candidates"] = len(driver2.candidates)
+        driver2.replay_candidates()
+        for inp in inputs:
+            if inp[0] not in driver.acked:
+                driver2.send(inp)
+        acked_all = set(driver2.acked)
+        say(f"replayed tail; {len(acked_all)} total acked")
+        sigkill(proc)           # crash-only: no graceful path, ever
+
+        # verify in-process: restored manager vs never-crashed serial
+        say("verifying frontier bit-exactness")
+        cfgR = Config(**manager_config(workdir, 0))
+        mgrR = Manager(cfgR, table=table)
+        for data in mgrR.candidates:
+            inp = driver2.acked.get(data)
+            if inp is not None:
+                _admit_direct(mgrR, inp)
+        wserial = os.path.join(base_dir, "w-serial")
+        cfgS = Config(**manager_config(wserial, 0))
+        mgrS = Manager(cfgS, table=table)
+        # share the restored manager's sparse→dense PC mapping so the
+        # bitmap comparison is literally bit-exact (dense indices are
+        # assigned on first sight; without a shared mapping the same
+        # frontier is a permutation of itself)
+        mgrS.pcmap.preseed(mgrR.pcmap.export_keys())
+        for inp in inputs:
+            if inp[0] in acked_all:
+                _admit_direct(mgrS, inp)
+        covR = np.asarray(mgrR.engine.corpus_cover)
+        covS = np.asarray(mgrS.engine.corpus_cover)
+        sigsR = {hashlib.sha1(d).hexdigest()
+                 for d in (it.data for it in mgrR.corpus.values())}
+        sigsS = {hashlib.sha1(d).hexdigest()
+                 for d in (it.data for it in mgrS.corpus.values())}
+        out["frontier_bit_exact"] = bool((covR == covS).all())
+        out["corpus_lost"] = len(sigsS - sigsR)
+        out["corpus_size"] = len(mgrR.corpus)
+        out["restored_from_snapshot"] = int(
+            mgrR._f_restore.labels(outcome="snapshot").value)
+        for m in (mgrR, mgrS):
+            m.server.close()
+            m.dstream.stop()
+            if m.coalescer is not None:
+                m.coalescer.stop()
+        if not out["frontier_bit_exact"]:
+            raise AssertionError(f"frontier diverged: {out}")
+        if out["corpus_lost"]:
+            raise AssertionError(f"corpus loss: {out}")
+        say(f"ok: {out}")
+        return out
+    finally:
+        if proc.poll() is None:
+            sigkill(proc)
+
+
+def _admit_direct(mgr, inp) -> dict:
+    data, call, ci, cover = inp
+    from syzkaller_tpu import rpc as rpc_mod
+
+    return mgr.rpc_new_input({
+        "name": "serial", "call": call, "prog": rpc_mod.b64(data),
+        "call_index": ci, "cover": cover})

@@ -1,0 +1,304 @@
+"""Crash-only snapshot/restore of the manager's device-resident state.
+
+Crash-only software has no graceful-shutdown path: the ONLY way the
+manager ever stops is (morally) a crash, and the only recovery path is
+the one exercised on every restart — restore the newest valid snapshot
+and replay the persistent-corpus tail admitted after it.  That keeps
+the restore path continuously tested instead of rotting next to a
+separate "clean shutdown" serializer.
+
+Snapshot file format (atomic tmp+rename, versioned, checksummed):
+
+    MAGIC "SYZSNAP1" | u32 header_len | header JSON | npz payload
+
+The header carries the format version, a sha256 over the payload, and
+the host-side metadata (corpus item table, campaign scheduler EWMAs,
+triage cluster index).  The payload is one numpy .npz with the engine
+bitmaps stored word-block-sparse (only 64-word blocks any call ever
+touched), the corpus signal matrix as COO, and per-campaign frontier
+views as their touched-block sets.  A corrupt or truncated snapshot
+fails checksum/parse and is skipped (counted), falling back to the
+next-newest file and ultimately to the cold full-corpus replay.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import io
+import json
+import os
+import struct
+import time
+
+import numpy as np
+
+from syzkaller_tpu.utils import fileutil, log
+
+MAGIC = b"SYZSNAP1"
+VERSION = 1
+BLOCK_WORDS = 64          # snapshot block granularity (bitmap W is
+#                           64-word aligned by nwords_for)
+
+
+class SnapshotError(Exception):
+    pass
+
+
+# -- word-block-sparse bitmap codec -----------------------------------------
+
+
+def pack_block_sparse(mat: np.ndarray, bw: int = BLOCK_WORDS
+                      ) -> "tuple[np.ndarray, np.ndarray]":
+    """(R, W) uint32 → (touched block ids, (nb, R, bw) slabs).  W must
+    be a multiple of bw (nwords_for aligns to 64)."""
+    R, W = mat.shape
+    nb = W // bw
+    blocked = mat.reshape(R, nb, bw)
+    touched = blocked.any(axis=(0, 2))
+    ids = np.nonzero(touched)[0].astype(np.int32)
+    data = blocked[:, ids].transpose(1, 0, 2).copy()
+    return ids, data
+
+
+def unpack_block_sparse(ids: np.ndarray, data: np.ndarray, R: int, W: int,
+                        bw: int = BLOCK_WORDS) -> np.ndarray:
+    out = np.zeros((R, W), np.uint32)
+    if len(ids):
+        out.reshape(R, W // bw, bw)[:, np.asarray(ids, np.int64)] = \
+            np.asarray(data, np.uint32).transpose(1, 0, 2)
+    return out
+
+
+# -- file codec -------------------------------------------------------------
+
+
+def encode_snapshot(meta: dict, arrays: dict) -> bytes:
+    buf = io.BytesIO()
+    np.savez(buf, **arrays)
+    payload = buf.getvalue()
+    header = dict(meta)
+    header["version"] = VERSION
+    header["sha256"] = hashlib.sha256(payload).hexdigest()
+    hb = json.dumps(header, sort_keys=True).encode()
+    return MAGIC + struct.pack("<I", len(hb)) + hb + payload
+
+
+def decode_snapshot(blob: bytes) -> "tuple[dict, dict]":
+    if blob[: len(MAGIC)] != MAGIC:
+        raise SnapshotError("bad magic")
+    off = len(MAGIC)
+    if len(blob) < off + 4:
+        raise SnapshotError("truncated header length")
+    (hlen,) = struct.unpack("<I", blob[off: off + 4])
+    off += 4
+    if len(blob) < off + hlen:
+        raise SnapshotError("truncated header")
+    try:
+        header = json.loads(blob[off: off + hlen])
+    except ValueError as e:
+        raise SnapshotError(f"header parse: {e}") from e
+    off += hlen
+    if header.get("version") != VERSION:
+        raise SnapshotError(f"version {header.get('version')} != {VERSION}")
+    payload = blob[off:]
+    if hashlib.sha256(payload).hexdigest() != header.get("sha256"):
+        raise SnapshotError("checksum mismatch")
+    try:
+        npz = np.load(io.BytesIO(payload), allow_pickle=False)
+        arrays = {k: npz[k] for k in npz.files}
+    except Exception as e:
+        raise SnapshotError(f"payload parse: {e}") from e
+    return header, arrays
+
+
+# -- manager-state collection/application -----------------------------------
+
+
+def collect_snapshot(manager) -> bytes:
+    """One consistent cut of the manager's restart-critical state.
+    Enters the admission gate exclusively so no admission is mid-flight
+    between the engine cut and the corpus-item table; file I/O happens
+    in the caller, after the gate is released."""
+    mgr = manager
+    with mgr._admit_gate.maintenance():
+        est = mgr.engine.export_state()
+        with mgr._mu:
+            items = [{"sig": sig.hex(), "call": it.call,
+                      "ci": it.call_index, "row": it.corpus_row}
+                     for sig, it in mgr.corpus.items()]
+        camp = mgr.campaign_sched.export_state()
+        tri_entries, tri_feats = mgr.crash_index.export_state()
+        fronts = {tag: v.export_blocks()
+                  for tag, v in mgr.engine.frontier_views().items()}
+
+    arrays = {
+        "prios": np.asarray(est["prios"], np.float32),
+        "enabled": np.asarray(est["enabled"], bool),
+        "corpus_call": np.asarray(est["corpus_call"], np.int32),
+        "triage_feats": np.asarray(tri_feats, np.float32),
+        # the PcMap's first-seen key order IS the meaning of every
+        # bitmap index — without it a restored frontier is gibberish
+        "pcmap_keys": mgr.pcmap.export_keys(),
+    }
+    for name in ("max_cover", "corpus_cover", "flakes"):
+        ids, data = pack_block_sparse(np.asarray(est[name], np.uint32))
+        arrays[f"{name}_ids"] = ids
+        arrays[f"{name}_data"] = data
+    cm = np.asarray(est["corpus_mat"], np.uint32)
+    rows, cols = np.nonzero(cm)
+    arrays["cm_rows"] = rows.astype(np.int32)
+    arrays["cm_cols"] = cols.astype(np.int32)
+    arrays["cm_vals"] = cm[rows, cols]
+    ftags = sorted(fronts)
+    for i, tag in enumerate(ftags):
+        ids, data = fronts[tag]
+        arrays[f"frontier{i}_ids"] = ids
+        arrays[f"frontier{i}_data"] = data
+    meta = {
+        "created_at": time.time(),
+        "name": mgr.cfg.name,
+        "npcs": est["npcs"], "ncalls": est["ncalls"], "W": est["W"],
+        "corpus_len": est["corpus_len"],
+        "corpus_items": items,
+        "campaign": camp,
+        "triage": [[cid, title, count]
+                   for cid, title, count in tri_entries],
+        "frontier_tags": ftags,
+    }
+    return encode_snapshot(meta, arrays)
+
+
+class RestoredState:
+    """Decoded snapshot, shaped for Manager application."""
+
+    def __init__(self, meta: dict, arrays: dict):
+        self.meta = meta
+        self.arrays = arrays
+        R, W = int(meta["ncalls"]), int(meta["W"])
+        n = int(meta["corpus_len"])
+        cm = np.zeros((n, W), np.uint32)
+        cm[arrays["cm_rows"], arrays["cm_cols"]] = arrays["cm_vals"]
+        self.engine_state = {
+            "npcs": int(meta["npcs"]), "ncalls": R, "W": W,
+            "corpus_len": n,
+            "corpus_mat": cm,
+            "corpus_call": arrays["corpus_call"],
+            "prios": arrays["prios"],
+            "enabled": arrays["enabled"],
+        }
+        for name in ("max_cover", "corpus_cover", "flakes"):
+            self.engine_state[name] = unpack_block_sparse(
+                arrays[f"{name}_ids"], arrays[f"{name}_data"], R, W)
+        self.corpus_items = meta.get("corpus_items", [])
+        self.campaign = meta.get("campaign") or {}
+        self.triage = [(cid, title, int(count))
+                       for cid, title, count in meta.get("triage", [])]
+        self.frontiers = {
+            tag: (arrays[f"frontier{i}_ids"], arrays[f"frontier{i}_data"])
+            for i, tag in enumerate(meta.get("frontier_tags", []))}
+        self.path = ""
+        self.corrupt_skipped = 0
+
+
+def snapshot_dir(workdir: str) -> str:
+    return os.path.join(workdir, "snapshots")
+
+
+def load_latest_snapshot(workdir: str) -> "RestoredState | None":
+    """Newest valid snapshot under workdir/snapshots/, skipping (and
+    counting) corrupt/truncated files; None when nothing restores."""
+    d = snapshot_dir(workdir)
+    try:
+        names = sorted((n for n in os.listdir(d)
+                        if n.startswith("snap-") and n.endswith(".ckpt")),
+                       reverse=True)
+    except OSError:
+        return None
+    corrupt = 0
+    for name in names:
+        path = os.path.join(d, name)
+        try:
+            with open(path, "rb") as f:
+                blob = f.read()
+            meta, arrays = decode_snapshot(blob)
+        except (OSError, SnapshotError) as e:
+            corrupt += 1
+            log.logf(0, "snapshot %s unusable (%s); trying older", name, e)
+            continue
+        st = RestoredState(meta, arrays)
+        st.path = path
+        st.corrupt_skipped = corrupt
+        return st
+    return None
+
+
+class Checkpointer:
+    """Periodic snapshot writer for one manager (crash-only restarts:
+    there is no shutdown serializer — the interval cadence IS the
+    persistence story, and restart replays the persistent-corpus tail
+    admitted after the newest snapshot)."""
+
+    def __init__(self, manager, interval: float = 300.0, keep: int = 3,
+                 registry=None):
+        self.mgr = manager
+        self.interval = float(interval)
+        self.keep = max(1, int(keep))
+        self.dir = snapshot_dir(manager.cfg.workdir)
+        self._last = time.monotonic()
+        self._seq = 0
+        self.stat_snapshots = 0
+        self._c_snapshots = None
+        self._c_errors = None
+        if registry is not None:
+            self._c_snapshots = registry.counter(
+                "syz_snapshot_total", "state snapshots written")
+            self._c_errors = registry.counter(
+                "syz_snapshot_errors_total", "snapshot writes that failed")
+            registry.gauge(
+                "syz_snapshot_age_seconds",
+                "seconds since the last successful snapshot",
+                fn=lambda: time.monotonic() - self._last)
+
+    def maybe_snapshot(self, now: "float | None" = None) -> "str | None":
+        if self.interval <= 0:
+            return None
+        now = time.monotonic() if now is None else now
+        if now - self._last < self.interval:
+            return None
+        return self.snapshot_once()
+
+    def snapshot_once(self) -> "str | None":
+        """Collect + write one snapshot; returns its path (None on
+        failure — a failed snapshot must never take the manager down,
+        the previous one is still on disk)."""
+        try:
+            blob = collect_snapshot(self.mgr)
+            self._seq += 1
+            name = f"snap-{int(time.time() * 1000):016d}-{self._seq:04d}.ckpt"
+            path = os.path.join(self.dir, name)
+            fileutil.write_file(path, blob)
+            self._last = time.monotonic()
+            self.stat_snapshots += 1
+            if self._c_snapshots is not None:
+                self._c_snapshots.inc()
+            self._prune()
+            log.logf(1, "snapshot %s: %d bytes, corpus %d", name,
+                     len(blob), len(self.mgr.corpus))
+            return path
+        except Exception as e:
+            if self._c_errors is not None:
+                self._c_errors.inc()
+            log.logf(0, "snapshot failed: %s", e)
+            return None
+
+    def _prune(self) -> None:
+        try:
+            names = sorted(n for n in os.listdir(self.dir)
+                           if n.startswith("snap-") and n.endswith(".ckpt"))
+        except OSError:
+            return
+        for name in names[: -self.keep]:
+            try:
+                os.unlink(os.path.join(self.dir, name))
+            except OSError:
+                pass

@@ -1,0 +1,285 @@
+"""Mid-run device-flap failover for the cover engine.
+
+The BENCH_r03–r05 failure mode — the device tunnel flapping — was only
+survivable at bench startup (bench.py falls back to CPU before any
+state exists).  `ResilientEngine` makes it survivable MID-RUN: it
+stands behind the same `CoverageEngine` seams every consumer already
+uses (manager admission, coalescer, decision streams, triage gauges),
+detects dispatch faults, quarantines the device backend, migrates the
+full engine state (bitmaps, corpus matrix, priority operands, frontier
+views) to a CPU-backed engine, retries the faulted call there, and
+keeps fuzzing degraded (`syz_backend_degraded` gauge = 1).  A periodic
+probe re-dispatches on the quarantined backend; success promotes state
+back (compile-free: the device engine's kernels are still warm, and
+state import moves arrays only).
+
+Concurrency: calls enter a SharedExclusiveGate shared; failover and
+promotion enter exclusive, so in-flight dispatches drain before state
+is exported and no call ever runs against a half-migrated engine.  No
+lock is held across device work (syz-vet lock discipline).
+
+`FaultInjector` is the chaos seam: it fires *before* the real dispatch
+(at the proxy), so injected faults never corrupt engine state — they
+model the tunnel dying, not the kernel mis-executing.
+"""
+
+from __future__ import annotations
+
+import inspect
+import threading
+import time
+
+from syzkaller_tpu.utils import log
+from syzkaller_tpu.utils.gate import SharedExclusiveGate
+
+
+class InjectedFault(RuntimeError):
+    """A chaos-injected device dispatch fault."""
+
+
+class FaultInjector:
+    """Arms N faults against the primary backend (optionally scoped to
+    a method-name set).  Thread-safe; `fired` counts what actually
+    went off."""
+
+    def __init__(self):
+        self._mu = threading.Lock()
+        self._n = 0
+        self._methods: "set[str] | None" = None
+        self.fired = 0
+
+    def arm(self, n: int = 1 << 30, methods=None) -> None:
+        with self._mu:
+            self._n = int(n)
+            self._methods = set(methods) if methods is not None else None
+
+    def disarm(self) -> None:
+        with self._mu:
+            self._n = 0
+            self._methods = None
+
+    @property
+    def armed(self) -> bool:
+        with self._mu:
+            return self._n > 0
+
+    def check(self, method: str, on_primary: bool) -> None:
+        if not on_primary:
+            return
+        with self._mu:
+            if self._n <= 0:
+                return
+            if self._methods is not None and method not in self._methods:
+                return
+            self._n -= 1
+            self.fired += 1
+        raise InjectedFault(f"injected device fault in {method}")
+
+
+# dispatch faults worth failing over for: backend/runtime errors and
+# transport breakage — NOT ValueError/TypeError (programming errors
+# must stay loud)
+FAULT_TYPES = (RuntimeError, OSError, SystemError)
+
+
+class ResilientEngine:
+    """CoverageEngine facade with device-flap failover.
+
+    Every attribute forwards to the active engine; callables are
+    wrapped with the fault guard.  `primary` is the device engine,
+    `fallback_factory()` builds the CPU-backed engine lazily on the
+    first fault (so healthy runs pay nothing)."""
+
+    def __init__(self, primary, fallback_factory, registry=None,
+                 probe_interval: float = 5.0, on_swap=None,
+                 injector: "FaultInjector | None" = None):
+        self._primary = primary
+        self._factory = fallback_factory
+        self._fallback = None
+        self._eng = primary
+        self._gate = SharedExclusiveGate()
+        self._on_swap = on_swap
+        self.injector = injector if injector is not None else FaultInjector()
+        self.probe_interval = float(probe_interval)
+        self._last_probe = 0.0
+        self._degraded_since: "float | None" = None
+        self.stat_failovers = 0
+        self.stat_promotions = 0
+        self.stat_faults = 0
+        self._c_faults = self._c_failovers = self._c_promotions = None
+        if registry is not None:
+            registry.gauge(
+                "syz_backend_degraded",
+                "1 while fuzzing on the CPU fallback engine "
+                "(device backend quarantined)",
+                fn=lambda: 1.0 if self.degraded else 0.0)
+            self._c_faults = registry.counter(
+                "syz_backend_faults_total",
+                "device dispatch faults the supervisor absorbed")
+            self._c_failovers = registry.counter(
+                "syz_backend_failover_total",
+                "device→CPU engine failovers")
+            self._c_promotions = registry.counter(
+                "syz_backend_promotions_total",
+                "CPU→device promotions after backend recovery")
+        self._init_done = True
+
+    # -- introspection -----------------------------------------------------
+
+    @property
+    def degraded(self) -> bool:
+        return self._eng is not self._primary
+
+    @property
+    def primary(self):
+        return self._primary
+
+    @property
+    def fallback(self):
+        return self._fallback
+
+    @property
+    def degraded_seconds(self) -> float:
+        since = self._degraded_since
+        return 0.0 if since is None else time.monotonic() - since
+
+    # -- forwarding --------------------------------------------------------
+
+    def __getattr__(self, name: str):
+        if name.startswith("_"):
+            raise AttributeError(name)
+        eng = object.__getattribute__(self, "_eng")
+        attr = getattr(eng, name)
+        # guard bound methods only: plain attributes (arrays, the jax
+        # Mesh — which happens to be callable) pass through untouched
+        if inspect.ismethod(attr) and attr.__self__ is eng:
+            return self._guarded(name)
+        return attr
+
+    def __setattr__(self, name: str, value) -> None:
+        # proxy-owned state (underscored, class-level, or set during
+        # __init__) stays on the proxy; anything else is engine state
+        # (e.g. a test poking corpus_len) and follows the active engine
+        if name.startswith("_") or not self.__dict__.get("_init_done") \
+                or name in self.__dict__ or hasattr(type(self), name):
+            object.__setattr__(self, name, value)
+        else:
+            setattr(object.__getattribute__(self, "_eng"), name, value)
+
+    def _guarded(self, name: str):
+        def call(*args, **kwargs):
+            err = None
+            for _ in range(3):          # primary → fallback → raise
+                with self._gate.shared():
+                    eng = self._eng
+                    try:
+                        self.injector.check(name, eng is self._primary)
+                        return getattr(eng, name)(*args, **kwargs)
+                    except FAULT_TYPES as e:
+                        err, failed = e, eng
+                # outside the shared region (failover needs exclusive)
+                if not self._absorb_fault(failed, name, err):
+                    raise err
+            raise err
+        call.__name__ = name
+        return call
+
+    def _absorb_fault(self, failed, name: str, err) -> bool:
+        """True = the call should retry on the (new) active engine."""
+        self.stat_faults += 1
+        if self._c_faults is not None:
+            self._c_faults.inc()
+        if failed is not self._primary:
+            # the CPU fallback itself faulted: nothing left to stand on
+            return False
+        self._failover(name, err)
+        return True
+
+    # -- failover / promotion ----------------------------------------------
+
+    def _failover(self, name: str, err) -> None:
+        """Swap to the CPU fallback.  The gate's exclusive mode is the
+        only serializer: it drains in-flight dispatches AND mutually
+        excludes a concurrent failover/promotion — no separate mutex is
+        ever held across the drain (syz-vet blocking-under-lock)."""
+        notified = False
+        with self._gate.exclusive():
+            if self._eng is not self._primary:
+                pass            # a concurrent call already failed over
+            else:
+                log.logf(0, "backend fault in %s (%s): quarantining "
+                         "device engine, failing over to CPU", name, err)
+                fb = self._fallback
+                if fb is None:
+                    fb = self._factory()
+                state = None
+                try:
+                    state = self._primary.export_state()
+                except FAULT_TYPES as e:
+                    log.logf(0, "device state unreadable (%s); CPU engine "
+                             "restarts from last snapshot/corpus replay", e)
+                if state is not None:
+                    fb.import_state(state)
+                fb.adopt_frontiers(self._primary.frontier_views())
+                self._fallback = fb
+                self._eng = fb
+                self.stat_failovers += 1
+                self._degraded_since = time.monotonic()
+                if self._c_failovers is not None:
+                    self._c_failovers.inc()
+                notified = True
+        if notified:
+            self._notify_swap()
+
+    def maybe_probe(self, now: "float | None" = None) -> bool:
+        """Recovery probe cadence (manager run-loop tick): when
+        degraded, re-dispatch on the quarantined backend every
+        `probe_interval`; success promotes back.  Returns True on a
+        promotion."""
+        if not self.degraded:
+            return False
+        now = time.monotonic() if now is None else now
+        if now - self._last_probe < self.probe_interval:
+            return False
+        self._last_probe = now
+        return self.probe()
+
+    def probe(self) -> bool:
+        try:
+            self.injector.check("probe", True)
+            self._primary.random_words(64)
+        except FAULT_TYPES:
+            return False
+        self._promote()
+        return True
+
+    def _promote(self) -> None:
+        promoted = False
+        with self._gate.exclusive():
+            if self._eng is not self._primary:
+                state = self._eng.export_state()
+                self._primary.import_state(state)
+                self._primary.adopt_frontiers(self._eng.frontier_views())
+                self._eng = self._primary
+                dur = self.degraded_seconds
+                self._degraded_since = None
+                self.stat_promotions += 1
+                if self._c_promotions is not None:
+                    self._c_promotions.inc()
+                log.logf(0, "device backend recovered: promoted back "
+                         "after %.1fs degraded", dur)
+                promoted = True
+        if promoted:
+            self._notify_swap()
+
+    def _notify_swap(self) -> None:
+        """Listeners (decision streams) re-upload cached device
+        operands + invalidate pre-drawn state; runs outside every
+        gate/lock so callbacks may use guarded engine methods."""
+        cb = self._on_swap
+        if cb is None:
+            return
+        try:
+            cb(self.degraded)
+        except Exception as e:
+            log.logf(0, "backend-swap listener failed: %s", e)

@@ -16,7 +16,10 @@ integer lists inside params — same spirit as the reference's JSON codec.
 from __future__ import annotations
 
 import base64
+import itertools
 import json
+import os
+import random
 import socket
 import socketserver
 import threading
@@ -109,18 +112,37 @@ class RpcServer:
 
 
 class RpcClient:
-    """Blocking JSON-lines RPC client with keep-alive reconnect."""
+    """Blocking JSON-lines RPC client with keep-alive reconnect and a
+    fault envelope: a mid-call socket break reconnects and retries with
+    jittered exponential backoff instead of raising straight through
+    (the old behavior killed the fuzzer proc loop on any transient
+    manager restart).  Every call carries a per-call idempotency key
+    (`idem` param, like the injected `trace`) so the server can dedup a
+    replayed side-effecting request — the manager does this for
+    NewInput.  Retries are counted into `retry_counter` (a telemetry
+    Counter: `syz_rpc_retries_total`) when provided."""
 
-    def __init__(self, addr: "tuple[str, int] | str", timeout: float = 60.0):
+    RETRIES = 4                   # attempts per call (1 + 3 retries)
+    BACKOFF = 0.05                # base backoff, full jitter
+    MAX_BACKOFF = 1.0
+
+    def __init__(self, addr: "tuple[str, int] | str", timeout: float = 60.0,
+                 retries: "int | None" = None, retry_counter=None):
         if isinstance(addr, str):
             host, _, port = addr.rpartition(":")
             addr = (host or "127.0.0.1", int(port))
         self.addr = addr
         self.timeout = timeout
+        self.retries = self.RETRIES if retries is None else max(1, retries)
+        self.retry_counter = retry_counter
         self._sock: "socket.socket | None" = None
         self._file = None
         self._id = 0
         self._mu = threading.Lock()
+        # idempotency-key prefix: unique per client process+object so a
+        # replayed request is recognizable server-side across reconnects
+        self._client_id = f"{os.getpid():x}-{id(self) & 0xffffff:x}"
+        self._seq = itertools.count(1)
 
     def _connect_unlocked(self) -> None:
         """Establish the TCP connection OUTSIDE `_mu`: connect can block
@@ -140,47 +162,74 @@ class RpcClient:
         s.close()
 
     def call(self, method: str, params: "dict | None" = None,
-             span=None) -> dict:
+             span=None, idempotent: bool = True) -> dict:
         """One RPC round trip.  `span` (a telemetry.trace.SpanContext)
         is injected into params as the `trace` field and gets an
         `rpc:<method>` hop with the client-observed duration — this is
-        how trace context propagates Connect → Poll → NewInput."""
+        how trace context propagates Connect → Poll → NewInput.
+
+        Transport faults (socket break, server restart mid-call)
+        reconnect and retry up to `retries` times with full-jitter
+        exponential backoff; the SAME `idem` key rides every attempt so
+        the server can dedup a request whose first reply was lost.
+        `idempotent=False` disables the retry (first transport fault
+        raises) for callers whose replay the server cannot dedup.
+        Server-side errors (RpcError) never retry — the server already
+        processed the request."""
+        params = dict(params or {})
+        params["idem"] = f"{self._client_id}:{next(self._seq)}"
         if span is not None:
-            params = dict(params or {})
             span.sent_at = time.time()
             params["trace"] = span.to_wire()
         t0 = time.monotonic()
         try:
-            return self._call_locked(method, params)
+            return self._call_retrying(method, params, idempotent)
         finally:
             if span is not None:
                 span.add_hop(f"rpc:{method}", time.monotonic() - t0)
 
-    def _call_locked(self, method: str, params: "dict | None") -> dict:
-        for attempt in (0, 1):
-            if self._sock is None:
-                self._connect_unlocked()
-            with self._mu:
-                if self._sock is None:
-                    continue        # raced with a close(); reconnect
-                try:
-                    self._id += 1
-                    req = {"id": self._id, "method": method,
-                           "params": params or {}}
-                    self._file.write(json.dumps(req).encode() + b"\n")
-                    self._file.flush()
-                    line = self._file.readline()
-                    if not line:
-                        raise ConnectionError("server closed connection")
-                    resp = json.loads(line)
-                    if resp.get("error"):
-                        raise RpcError(resp["error"])
-                    return resp.get("result") or {}
-                except (OSError, ConnectionError, json.JSONDecodeError):
-                    self.close_socket()
-                    if attempt == 1:
-                        raise
+    def _call_retrying(self, method: str, params: dict,
+                       idempotent: bool) -> dict:
+        attempts = self.retries if idempotent else 1
+        for attempt in range(attempts):
+            try:
+                return self._call_once(method, params)
+            except (OSError, ConnectionError, json.JSONDecodeError):
+                if attempt + 1 >= attempts:
+                    raise
+                if self.retry_counter is not None:
+                    try:
+                        self.retry_counter.inc()
+                    except Exception:
+                        pass     # telemetry must never break the wire
+                # full-jitter exponential backoff: desynchronizes a
+                # fleet of fuzzers re-attacking a restarting manager
+                cap = min(self.MAX_BACKOFF, self.BACKOFF * (2 ** attempt))
+                time.sleep(random.uniform(0, cap))
         raise RpcError("unreachable")
+
+    def _call_once(self, method: str, params: dict) -> dict:
+        if self._sock is None:
+            self._connect_unlocked()
+        with self._mu:
+            if self._sock is None:
+                raise ConnectionError("connection raced with close()")
+            try:
+                self._id += 1
+                req = {"id": self._id, "method": method,
+                       "params": params or {}}
+                self._file.write(json.dumps(req).encode() + b"\n")
+                self._file.flush()
+                line = self._file.readline()
+                if not line:
+                    raise ConnectionError("server closed connection")
+                resp = json.loads(line)
+                if resp.get("error"):
+                    raise RpcError(resp["error"])
+                return resp.get("result") or {}
+            except (OSError, ConnectionError, json.JSONDecodeError):
+                self.close_socket()
+                raise
 
     def close_socket(self) -> None:
         if self._sock is not None:

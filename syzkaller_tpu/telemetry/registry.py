@@ -174,6 +174,15 @@ class EwmaRate:
             self._rate = alpha * (n / dt) + (1.0 - alpha) * self._rate
             self._last = now
 
+    def seed(self, rate: float, now: "float | None" = None) -> None:
+        """Restore a persisted rate estimate (snapshot/restore path):
+        the estimate resumes from `rate` as if the last sample landed
+        at `now`, decaying normally from there."""
+        now = time.monotonic() if now is None else now
+        with self._lock:
+            self._rate = float(rate)
+            self._last = now
+
     def rate(self, now: "float | None" = None) -> float:
         now = time.monotonic() if now is None else now
         with self._lock:

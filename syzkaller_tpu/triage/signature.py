@@ -250,6 +250,32 @@ class CrashIndex:
         with self._mu:
             return {c.cid: c.count for c in self._clusters}
 
+    def export_state(self) -> "tuple[list, np.ndarray]":
+        """Snapshot serialization: (cid, title, count) per cluster plus
+        the representative feature matrix (stacked in the same order) —
+        restoring the EXACT feature vectors keeps post-restore
+        assignments identical to the never-crashed index (rebuild()
+        from crash dirs re-featurizes from report0, which is the
+        fallback path)."""
+        with self._mu:
+            entries = [(c.cid, c.title, c.count) for c in self._clusters]
+            feats = (np.stack([c.feat for c in self._clusters])
+                     if self._clusters else np.zeros((0, 0), np.float32))
+        return entries, feats
+
+    def import_state(self, entries, feats) -> None:
+        """Restore an `export_state` cut; existing cluster ids win (the
+        crash-dir rebuild is authoritative when both ran)."""
+        with self._mu:
+            for (cid, title, count), f in zip(entries, feats):
+                if cid in self._by_id:
+                    continue
+                c = Cluster(cid=cid, title=title,
+                            feat=np.asarray(f, np.float32),
+                            count=int(count))
+                self._clusters.append(c)
+                self._by_id[cid] = c
+
     def rebuild(self, entries: "list[tuple[str, str, list[str], int]]"
                 ) -> None:
         """Restore representatives from persisted crash state:

@@ -1,0 +1,55 @@
+"""Shared/exclusive gate without a mutex held across device work.
+
+Extracted from the manager's AdmissionGate (PR 7) so the resilience
+plane can reuse the same pattern: hot-path operations enter *shared*
+(an in-flight count); rare maintenance operations (corpus compaction,
+backend failover/promotion, snapshotting) enter *exclusive* — they wait
+for in-flight shared work to drain and block new shared entries while
+they run.  No lock is held inside either region, so device syncs under
+the gate never serialize unrelated threads (syz-vet lock discipline).
+"""
+
+from __future__ import annotations
+
+import threading
+from contextlib import contextmanager
+
+
+class SharedExclusiveGate:
+    def __init__(self):
+        self._cv = threading.Condition()
+        self._inflight = 0
+        self._exclusive = False
+
+    @contextmanager
+    def shared(self):
+        with self._cv:
+            while self._exclusive:
+                self._cv.wait()
+            self._inflight += 1
+        try:
+            yield
+        finally:
+            with self._cv:
+                self._inflight -= 1
+                if self._inflight == 0:
+                    self._cv.notify_all()
+
+    @contextmanager
+    def exclusive(self):
+        with self._cv:
+            while self._exclusive:
+                self._cv.wait()
+            self._exclusive = True
+            while self._inflight:
+                self._cv.wait()
+        try:
+            yield
+        finally:
+            with self._cv:
+                self._exclusive = False
+                self._cv.notify_all()
+
+    # admission-plane aliases (the manager's historical vocabulary)
+    admitting = shared
+    maintenance = exclusive

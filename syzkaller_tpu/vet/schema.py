@@ -22,8 +22,9 @@ Findings:
   * P1 `param-unread` / `param-never-written` (optional reads) /
     `response-drift`: asymmetric keys in either direction.
 
-`trace` is allowlisted in both directions: RpcClient.call injects it
-and the telemetry observer reads it for every method.
+`trace` and `idem` are allowlisted in both directions: RpcClient.call
+injects both (trace context and the per-call idempotency key) and the
+telemetry observer / replay dedup read them for every method.
 """
 
 from __future__ import annotations
@@ -32,7 +33,7 @@ import ast
 
 from syzkaller_tpu.vet.core import P0, P1, Finding, SourceFile, dotted
 
-ALLOW_KEYS = {"trace"}
+ALLOW_KEYS = {"trace", "idem"}
 FOLLOW_DEPTH = 3
 
 

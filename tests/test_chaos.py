@@ -1,0 +1,500 @@
+"""Fault-tolerance plane tests: crash-only snapshot/restore proven
+bit-exact against a never-crashed serial run, mid-run device-flap
+failover that never blocks consumers, the RPC fault envelope
+(retry/backoff + idempotent replay dedup + dead-connection reaping),
+and the full SIGKILL-the-manager-mid-admission-storm chaos cycle
+against a real subprocess fleet."""
+
+import hashlib
+import os
+import shutil
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from syzkaller_tpu import rpc
+from syzkaller_tpu.manager.config import Config
+from syzkaller_tpu.manager.manager import Manager
+from syzkaller_tpu.resilience import (
+    FaultInjector, ResilientEngine, SnapshotError, chaos, checkpoint)
+from syzkaller_tpu.sys.table import load_table
+
+
+@pytest.fixture(scope="module")
+def table():
+    return load_table(files=["probe.txt"])
+
+
+def make_mgr(workdir, table, **over):
+    cfg = dict(chaos.manager_config(str(workdir), 0), admit_batch=1)
+    cfg.update(over)
+    return Manager(Config(**cfg), table=table)
+
+
+def stop_mgr(mgr):
+    mgr.server.close()
+    mgr.dstream.stop()
+    if mgr.coalescer is not None:
+        mgr.coalescer.stop()
+
+
+# -- snapshot codec ----------------------------------------------------------
+
+
+def test_snapshot_codec_roundtrip_and_corruption():
+    meta = {"npcs": 64, "corpus_items": [{"sig": "ab", "call": "x",
+                                         "ci": 0, "row": 1}]}
+    arrays = {"a": np.arange(7, dtype=np.uint32),
+              "b": np.ones((2, 3), np.float32)}
+    blob = checkpoint.encode_snapshot(meta, arrays)
+    m2, a2 = checkpoint.decode_snapshot(blob)
+    assert m2["npcs"] == 64 and m2["corpus_items"][0]["sig"] == "ab"
+    assert (a2["a"] == arrays["a"]).all() and (a2["b"] == arrays["b"]).all()
+    # tamper with the payload → checksum failure
+    bad = bytearray(blob)
+    bad[-3] ^= 0x40
+    with pytest.raises(SnapshotError, match="checksum"):
+        checkpoint.decode_snapshot(bytes(bad))
+    # truncation → parse/length failure, never a crash
+    with pytest.raises(SnapshotError):
+        checkpoint.decode_snapshot(blob[: len(blob) // 2])
+    with pytest.raises(SnapshotError, match="magic"):
+        checkpoint.decode_snapshot(b"NOTASNAP" + blob[8:])
+
+
+def test_block_sparse_codec(rng):
+    mat = np.zeros((5, 320), np.uint32)
+    mask = rng.random(mat.shape) < 0.03
+    mat[mask] = rng.integers(1, 2 ** 32, size=int(mask.sum()),
+                             dtype=np.uint32)
+    ids, data = checkpoint.pack_block_sparse(mat)
+    assert len(ids) <= 320 // 64
+    back = checkpoint.unpack_block_sparse(ids, data, 5, 320)
+    assert (back == mat).all()
+    # empty matrix → empty block set
+    ids0, data0 = checkpoint.pack_block_sparse(np.zeros((5, 320), np.uint32))
+    assert len(ids0) == 0
+    assert (checkpoint.unpack_block_sparse(ids0, data0, 5, 320) == 0).all()
+
+
+# -- crash-only restore ------------------------------------------------------
+
+
+def test_restore_bit_exact_vs_serial(tmp_path, table):
+    """Snapshot mid-run, 'crash', restore + tail replay: the recovered
+    frontier must be BIT-exact against a never-crashed serial manager
+    admitting the same inputs (sharing the restored sparse→dense PC
+    mapping, so the bitmaps compare literally)."""
+    inputs = chaos.synth_inputs(table, 30, seed=11)
+    acked = {inp[0]: inp for inp in inputs}
+    w = tmp_path / "w"
+    mgr = make_mgr(w, table)
+    for inp in inputs[:20]:
+        chaos._admit_direct(mgr, inp)
+    assert mgr.checkpointer.snapshot_once() is not None
+    for inp in inputs[20:]:
+        chaos._admit_direct(mgr, inp)
+    stop_mgr(mgr)        # crash-only: no state is written at stop
+
+    mgr2 = make_mgr(w, table)
+    assert int(mgr2._f_restore.labels(outcome="snapshot").value) == 1
+    assert len(mgr2.corpus) == 20
+    tail = list(mgr2.candidates)
+    assert 0 < len(tail) <= 10
+    for data in tail:
+        chaos._admit_direct(mgr2, acked[data])
+    assert len(mgr2.corpus) == 30
+
+    mgr3 = make_mgr(tmp_path / "serial", table)
+    mgr3.pcmap.preseed(mgr2.pcmap.export_keys())
+    for inp in inputs:
+        chaos._admit_direct(mgr3, inp)
+    covR = np.asarray(mgr2.engine.corpus_cover)
+    covS = np.asarray(mgr3.engine.corpus_cover)
+    assert (covR == covS).all()
+    assert (np.asarray(mgr2.engine.max_cover)
+            == np.asarray(mgr3.engine.max_cover)).all()
+    assert {hashlib.sha1(it.data).hexdigest()
+            for it in mgr2.corpus.values()} == \
+           {hashlib.sha1(it.data).hexdigest()
+            for it in mgr3.corpus.values()}
+    assert mgr2.engine.corpus_len == mgr3.engine.corpus_len
+    stop_mgr(mgr2)
+    stop_mgr(mgr3)
+
+
+def test_restore_skips_corrupt_snapshot(tmp_path, table):
+    """A corrupt newest snapshot is skipped (counted) and the older one
+    restores; all snapshots corrupt → cold full replay."""
+    inputs = chaos.synth_inputs(table, 16, seed=5)
+    w = tmp_path / "w"
+    mgr = make_mgr(w, table)
+    for inp in inputs[:8]:
+        chaos._admit_direct(mgr, inp)
+    p1 = mgr.checkpointer.snapshot_once()
+    for inp in inputs[8:]:
+        chaos._admit_direct(mgr, inp)
+    time.sleep(0.002)        # distinct ms timestamp in the filename
+    p2 = mgr.checkpointer.snapshot_once()
+    assert p1 != p2
+    stop_mgr(mgr)
+    with open(p2, "r+b") as f:          # truncate the newest
+        f.truncate(40)
+
+    mgr2 = make_mgr(w, table)
+    assert int(mgr2._c_snapshot_corrupt.value) == 1
+    assert len(mgr2.corpus) == 8 and len(mgr2.candidates) == 8
+    stop_mgr(mgr2)
+
+    with open(p1, "r+b") as f:
+        f.truncate(17)
+    mgr3 = make_mgr(w, table)
+    assert int(mgr3._f_restore.labels(outcome="cold").value) == 1
+    assert len(mgr3.corpus) == 0 and len(mgr3.candidates) == 16
+    stop_mgr(mgr3)
+
+
+def test_restore_tail_replay_faster_than_cold(tmp_path, table):
+    """The whole point of the snapshot: restart replays the tail, not
+    the corpus.  Structural claim (tail ≪ full corpus) plus a timing
+    claim on the warmed replay loops."""
+    n = 128
+    inputs = chaos.synth_inputs(table, n + 2, seed=9)
+    warm_a, warm_b = inputs[n], inputs[n + 1]
+    inputs = inputs[:n]
+    acked = {inp[0]: inp for inp in inputs}
+    w = tmp_path / "w"
+    mgr = make_mgr(w, table)
+    for inp in inputs[:112]:
+        chaos._admit_direct(mgr, inp)
+    mgr.checkpointer.snapshot_once()
+    for inp in inputs[112:]:
+        chaos._admit_direct(mgr, inp)
+    stop_mgr(mgr)
+    # the cold side works on a copy WITHOUT the snapshots dir
+    wcold = tmp_path / "wcold"
+    shutil.copytree(w, wcold)
+    shutil.rmtree(wcold / "snapshots")
+
+    mgr_r = make_mgr(w, table)
+    chaos._admit_direct(mgr_r, warm_a)      # warm the dispatch path
+    tail = [d for d in mgr_r.candidates]
+    t0 = time.monotonic()
+    for data in tail:
+        chaos._admit_direct(mgr_r, acked[data])
+    t_restored = time.monotonic() - t0
+
+    mgr_c = make_mgr(wcold, table)
+    chaos._admit_direct(mgr_c, warm_b)
+    cold = [d for d in mgr_c.candidates]
+    t0 = time.monotonic()
+    for data in cold:
+        chaos._admit_direct(mgr_c, acked[data])
+    t_cold = time.monotonic() - t0
+
+    assert len(tail) == 16 and len(cold) == n
+    assert t_restored < t_cold, (t_restored, t_cold)
+    stop_mgr(mgr_r)
+    stop_mgr(mgr_c)
+
+
+def test_restore_preserves_campaign_and_frontiers(tmp_path, table):
+    """Scheduler EWMAs/tags and per-campaign frontier views ride the
+    snapshot."""
+    w = tmp_path / "w"
+    mgr = make_mgr(w, table)
+    mgr.campaign_sched.campaigns = ["vnet-tcp"]
+    mgr.campaign_sched._rates.setdefault(
+        "vnet-tcp", type(mgr.campaign_sched._rates["all"])(120.0))
+    mgr.campaign_sched._tags["vnet-tcp"] = []
+    mgr.campaign_sched.assign("vm0")
+    mgr.campaign_sched.note_execs("vm0", 1000)
+    mgr.campaign_sched.note_new_cov("vm0", 64, sig_hex="aa" * 20)
+    view = mgr.engine.frontier_view("vnet-tcp")
+    view.mark([3, 70, 2049], call_id=2)
+    for inp in chaos.synth_inputs(table, 4, seed=2):
+        chaos._admit_direct(mgr, inp)
+    mgr.checkpointer.snapshot_once()
+    stop_mgr(mgr)
+
+    mgr2 = make_mgr(w, table, campaigns=["vnet-tcp"])
+    st = mgr2.campaign_sched.export_state()
+    assert st["rates"]["vnet-tcp"]["exec_total"] == 1000
+    assert st["rates"]["vnet-tcp"]["cov_total"] == 64
+    assert "aa" * 20 in st["tags"]["vnet-tcp"]
+    v2 = mgr2.engine.frontier_view("vnet-tcp")
+    assert v2.popcount() == 3
+    assert (v2.to_dense() == view.to_dense()).all()
+    stop_mgr(mgr2)
+
+
+# -- device-flap failover ----------------------------------------------------
+
+
+def _small_engine():
+    from syzkaller_tpu.cover.engine import CoverageEngine
+
+    return CoverageEngine(npcs=1 << 12, ncalls=48, corpus_cap=256)
+
+
+def _admit_rows(eng, start, n):
+    idx = (np.arange(16)[None, :] * 5 + start
+           + np.arange(n)[:, None] * 90).astype(np.int32)
+    cids = (np.arange(n) % 48).astype(np.int32)
+    hn, _rows = eng.admit_if_new(cids, idx, np.ones_like(idx, bool))
+    return int(np.asarray(hn).sum())
+
+
+def test_failover_migrates_state_and_keeps_serving():
+    """An injected dispatch fault mid-run: the supervisor quarantines
+    the primary, migrates the full engine state to the CPU fallback,
+    the faulted call retries transparently (zero admitted-input loss),
+    consumers never block >1s, and recovery promotes state back."""
+    from syzkaller_tpu.fuzzer.device_ct import DecisionStream
+    from syzkaller_tpu.telemetry import Registry
+
+    reg = Registry()
+    primary = _small_engine()
+    eng = ResilientEngine(primary, _small_engine, registry=reg,
+                          probe_interval=0.0)
+    stream = DecisionStream(eng, per_row=16, hot_slots=64, corpus_rows=32,
+                            entropy_words=1024, autostart=False)
+    eng._on_swap = lambda d: stream.rebind()
+    admitted = _admit_rows(eng, 0, 8)
+    assert admitted == 8 and not eng.degraded
+    stream.refill_once()
+
+    eng.injector.arm()
+    t0 = time.monotonic()
+    got = _admit_rows(eng, 4096 // 2, 4)   # faults → failover → retried
+    dt = time.monotonic() - t0
+    assert eng.degraded and got == 4
+    assert eng.injector.fired >= 1
+    assert reg.snapshot()["syz_backend_degraded"] == 1.0
+    assert eng.corpus_len == 12            # nothing lost in the swap
+    # consumers keep drawing on the fallback without blocking
+    t0 = time.monotonic()
+    draws = stream.take(-1, 16)
+    assert len(draws) == 16
+    assert time.monotonic() - t0 < 1.0
+
+    eng.injector.disarm()
+    assert eng.maybe_probe() is True
+    assert not eng.degraded
+    assert primary.corpus_len == 12        # state promoted back
+    assert (np.asarray(primary.corpus_cover)
+            == np.asarray(eng.fallback.corpus_cover)).all()
+    snap = reg.snapshot()
+    assert snap["syz_backend_degraded"] == 0.0
+    assert snap["syz_backend_failover_total"] == 1
+    assert snap["syz_backend_promotions_total"] == 1
+    assert dt < 30.0                       # failover itself is bounded
+    stream.stop()
+
+
+def test_failover_promotion_compiles_nothing_warm():
+    """Promotion back to the (still-warm) device engine moves arrays
+    only: CompileCounter pins zero recompiles across probe + the first
+    post-promotion decision block and admission."""
+    from syzkaller_tpu.fuzzer.device_ct import DecisionStream
+    from syzkaller_tpu.vet.runtime import CompileCounter
+
+    primary = _small_engine()
+    eng = ResilientEngine(primary, _small_engine, probe_interval=0.0)
+    stream = DecisionStream(eng, per_row=16, hot_slots=64, corpus_rows=32,
+                            entropy_words=1024, autostart=False)
+    eng._on_swap = lambda d: stream.rebind()
+    _admit_rows(eng, 0, 8)
+    _admit_rows(eng, 1500, 2)    # warm the (2, K) admission shape too
+    stream.refill_once()
+    stream.take(-1, 8)
+    primary.random_words(64)               # warm the probe's dispatch
+    eng.injector.arm()
+    _admit_rows(eng, 2000, 2)              # → degraded (fallback warms)
+    assert eng.degraded
+    stream.refill_once()
+    _admit_rows(eng, 2500, 2)
+    eng.injector.disarm()
+    with CompileCounter() as cc:
+        assert eng.probe() is True
+        stream.refill_once()               # first steered block, primary
+        _admit_rows(eng, 3000, 2)          # first admission, primary
+    assert cc.count == 0, f"{cc.count} recompiles across promotion"
+    stream.stop()
+
+
+def test_fallback_fault_raises():
+    """When the CPU fallback itself faults there is nothing to stand
+    on: the error surfaces instead of looping."""
+    eng = ResilientEngine(_small_engine(), _small_engine,
+                          probe_interval=0.0)
+    eng.injector.arm()
+    _admit_rows(eng, 0, 2)
+    assert eng.degraded
+    # fault the fallback directly (injector only fires on the primary)
+    orig = eng.fallback.admit_if_new
+    eng.fallback.admit_if_new = lambda *a, **k: (_ for _ in ()).throw(
+        RuntimeError("fallback died"))
+    with pytest.raises(RuntimeError, match="fallback died"):
+        _admit_rows(eng, 500, 2)
+    eng.fallback.admit_if_new = orig
+
+
+# -- RPC fault envelope ------------------------------------------------------
+
+
+def test_rpc_retry_survives_severed_socket_mid_call():
+    """A proxied connection hard-closed mid-Poll: the client
+    reconnects and retries behind the same call(), counting the retry."""
+    from syzkaller_tpu.telemetry import Registry
+
+    srv = rpc.RpcServer()
+    calls = []
+
+    def slow_echo(params):
+        calls.append(1)
+        time.sleep(0.25)
+        return {"n": len(calls)}
+
+    srv.register("Manager.Poll", slow_echo)
+    srv.serve_background()
+    proxy = chaos.ChaosProxy(srv.addr)
+    reg = Registry()
+    ctr = reg.counter("syz_rpc_retries_total", "")
+    cli = rpc.RpcClient(proxy.addr, retry_counter=ctr)
+    try:
+        assert cli.call("Manager.Poll", {})["n"] == 1       # warm path
+        severer = threading.Timer(0.1, proxy.sever)
+        severer.start()
+        r = cli.call("Manager.Poll", {})                    # severed mid-call
+        severer.join()
+        assert proxy.stat_severed >= 1
+        assert r["n"] >= 2                  # the retry round-tripped
+        assert ctr.value >= 1
+    finally:
+        cli.close()
+        proxy.close()
+        srv.close()
+
+
+def test_rpc_non_idempotent_does_not_retry():
+    cli = rpc.RpcClient(("127.0.0.1", chaos.free_port()), timeout=2.0)
+    t0 = time.monotonic()
+    with pytest.raises(OSError):
+        cli.call("Manager.Poll", {}, idempotent=False)
+    fast = time.monotonic() - t0
+    t0 = time.monotonic()
+    with pytest.raises(OSError):
+        cli.call("Manager.Poll", {})        # 4 attempts + backoff
+    slow = time.monotonic() - t0
+    assert slow > fast
+    cli.close()
+
+
+def test_new_input_idempotent_replay(tmp_path, table):
+    """A replayed NewInput (same idem key) is served from the dedup
+    cache: side effects run once."""
+    mgr = make_mgr(tmp_path / "w", table)
+    inp = chaos.synth_inputs(table, 1, seed=4)[0]
+    data, call, ci, cover = inp
+    params = {"name": "vm0", "call": call, "prog": rpc.b64(data),
+              "call_index": ci, "cover": cover, "idem": "replay-key-1"}
+    mgr.rpc_new_input(dict(params))
+    before = int(mgr._c_inputs.value)
+    mgr.rpc_new_input(dict(params))         # the replay
+    assert int(mgr._c_inputs.value) == before
+    assert int(mgr._c_replays.value) == 1
+    assert len(mgr.corpus) == 1
+    stop_mgr(mgr)
+
+
+def test_dead_connection_reaping(tmp_path, table):
+    """A fuzzer conn silent past conn_timeout is reaped: its queued
+    inputs move to a survivor (or the orphan stash) and its campaign
+    assignment returns to the pool."""
+    mgr = make_mgr(tmp_path / "w", table, conn_timeout=5.0)
+    mgr.rpc_connect({"name": "vm0"})
+    mgr.rpc_connect({"name": "vm1"})
+    inp = chaos.synth_inputs(table, 1, seed=8)[0]
+    data, call, ci, cover = inp
+    mgr.rpc_new_input({"name": "vm0", "call": call, "prog": rpc.b64(data),
+                       "call_index": ci, "cover": cover})
+    assert len(mgr.fuzzers["vm1"].input_queue) == 1   # broadcast queued
+    # vm1 goes silent; vm0 stays live
+    with mgr._mu:
+        mgr.fuzzers["vm1"].last_seen -= 60.0
+    dead = mgr.reap_dead_conns()
+    assert dead == ["vm1"]
+    assert "vm1" not in mgr.fuzzers
+    assert int(mgr._c_reaped.value) == 1
+    # the queued input survived, re-routed to the survivor
+    assert len(mgr.fuzzers["vm0"].input_queue) == 1
+    # everyone silent → inputs stash for the next Connect
+    with mgr._mu:
+        mgr.fuzzers["vm0"].last_seen -= 60.0
+    assert mgr.reap_dead_conns() == ["vm0"]
+    assert len(mgr._orphan_inputs) == 1
+    r = mgr.rpc_connect({"name": "vm2"})
+    assert r is not None
+    assert len(mgr.fuzzers["vm2"].input_queue) == 1
+    assert len(mgr._orphan_inputs) == 0
+    stop_mgr(mgr)
+
+
+# -- shutdown hygiene --------------------------------------------------------
+
+
+def test_stop_paths_idempotent(tmp_path, table):
+    """Double-close of the decision stream / coalescer / manager stop
+    paths must be safe (crash-only software gets stopped twice a lot)."""
+    mgr = make_mgr(tmp_path / "w", table, admit_batch=8)
+    assert mgr.coalescer is not None
+    assert mgr.dstream.stop() is True
+    assert mgr.dstream.stop() is True       # second close: no-op
+    assert mgr.coalescer.stop() is True
+    assert mgr.coalescer.stop() is True
+    mgr.stop()
+    mgr.stop()                              # full manager double-stop
+    leaks = mgr._f_thread_leaks
+    assert all(int(c.value) == 0 for c in [
+        leaks.labels(thread="vm-loop"),
+        leaks.labels(thread="coalescer"),
+        leaks.labels(thread="decision-stream")])
+
+
+def test_persistent_corrupt_load_counted(tmp_path):
+    from syzkaller_tpu.manager.persistent import PersistentSet
+    from syzkaller_tpu.telemetry import Registry
+
+    reg = Registry()
+    ctr = reg.counter("syz_corpus_load_corrupt_total", "")
+    d = str(tmp_path / "corpus")
+    ps = PersistentSet(d)
+    ps.add(b"prog-a\n")
+    with open(os.path.join(d, "0" * 40), "wb") as f:
+        f.write(b"wrong content for that sig")
+    with open(os.path.join(d, ".tmp-orphan"), "wb") as f:
+        f.write(b"half-written")
+    ps2 = PersistentSet(d, corrupt_counter=ctr)
+    assert len(ps2) == 1
+    assert int(ctr.value) == 1
+    assert not os.path.exists(os.path.join(d, ".tmp-orphan"))
+
+
+# -- the full chaos cycle (real subprocess fleet) ----------------------------
+
+
+def test_sigkill_manager_mid_admission_storm(tmp_path):
+    """The acceptance scenario end to end: a real manager subprocess is
+    SIGKILLed mid-admission-storm after a snapshot lands; restart
+    restores the snapshot, serves Poll within bounded time, replays the
+    persistent tail, and the recovered frontier is bit-exact vs a
+    never-crashed serial replay with zero corpus loss."""
+    out = chaos.run_kill_restore_cycle(str(tmp_path), n_inputs=24)
+    assert out["frontier_bit_exact"]
+    assert out["corpus_lost"] == 0
+    assert out["restored_from_snapshot"] == 1
+    assert out["corpus_size"] == 24
+    assert out["recovery_seconds"] < 60.0

@@ -28,8 +28,11 @@ def test_config_unknown_field():
 
 
 def test_config_validation():
+    # count=0 is legal (external fuzzers over RPC — the chaos harness);
+    # negative is not
     with pytest.raises(ConfigError, match="count"):
-        loads('{"count": 0}')
+        loads('{"count": -1}')
+    loads('{"count": 0}')
     with pytest.raises(ConfigError, match="procs"):
         loads('{"procs": 64}')
     with pytest.raises(ConfigError, match="VM type"):
@@ -165,7 +168,9 @@ def test_rpc_roundtrip():
     srv.serve_background()
     try:
         cli = rpc.RpcClient(srv.addr)
-        assert cli.call("Echo", {"x": [1, 2]}) == {"got": {"x": [1, 2]}}
+        # params carry the injected idempotency key next to the payload
+        got = cli.call("Echo", {"x": [1, 2]})["got"]
+        assert got["x"] == [1, 2] and got["idem"]
         with pytest.raises(rpc.RpcError, match="ZeroDivisionError"):
             cli.call("Boom")
         with pytest.raises(rpc.RpcError, match="unknown method"):

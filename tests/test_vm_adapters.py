@@ -264,6 +264,80 @@ def test_lkvm_requires_kernel():
         loads('{"type": "lkvm", "workdir": "/tmp/x"}')
 
 
+# -- monitor failure classification -----------------------------------------
+# (vm/monitor.py's outcome classes drive syz_vm_outcomes_total — the
+# fleet-health series the autopilot's robustness half keys on; the
+# lost_connection / preempted / no_output-timeout paths were untested)
+
+
+def _run_monitor(chunks, outcomes=None, timeout=10.0):
+    import queue
+
+    from syzkaller_tpu.vm.base import RunHandle
+    from syzkaller_tpu.vm.monitor import monitor_execution
+
+    q = queue.Queue()
+    for c in chunks:
+        q.put(c)
+    h = RunHandle(output=q, stop=lambda: None, is_alive=lambda: True)
+    return monitor_execution(h, timeout=timeout, outcomes=outcomes)
+
+
+def _outcome_family():
+    from syzkaller_tpu.telemetry import Registry
+
+    return Registry().counter("syz_vm_outcomes_total", "",
+                              labels=("outcome",))
+
+
+def test_monitor_classifies_lost_connection():
+    fam = _outcome_family()
+    out = _run_monitor([b"executing program 0:\nfoo()\n", None],
+                       outcomes=fam)
+    assert out.crashed and out.title == "lost connection to test machine"
+    assert fam.labels(outcome="lost_connection").value == 1
+
+
+def test_monitor_classifies_preempted():
+    fam = _outcome_family()
+    out = _run_monitor([b"executing program 0:\nfoo()\n", b"PREEMPTED\n"],
+                       outcomes=fam)
+    assert out.title == "preempted" and out.timed_out and not out.crashed
+    assert fam.labels(outcome="preempted").value == 1
+
+
+def test_monitor_classifies_no_output_before_executing():
+    # EOF with no "executing program" marker: the machine booted but
+    # never ran anything — classified no_output, not lost_connection
+    fam = _outcome_family()
+    out = _run_monitor([b"booted, then silence\n", None], outcomes=fam)
+    assert out.crashed and out.title == "no output from test machine"
+    assert fam.labels(outcome="no_output").value == 1
+
+
+def test_monitor_no_output_timeout_path(monkeypatch):
+    # the liveness TIMEOUT path (ref vm.go's 3-minute no-output rule),
+    # distinct from the EOF path: the stream stays open but silent
+    from syzkaller_tpu.vm import monitor as mon
+
+    monkeypatch.setattr(mon, "NO_OUTPUT_TIMEOUT", 0.3)
+    fam = _outcome_family()
+    t0 = __import__("time").monotonic()
+    out = _run_monitor([b"executing program 0:\nfoo()\n"],
+                       outcomes=fam, timeout=30.0)
+    assert out.crashed and out.title == "no output from test machine"
+    assert __import__("time").monotonic() - t0 < 10.0   # not the 30s cap
+    assert fam.labels(outcome="no_output").value == 1
+
+
+def test_monitor_classifies_overall_timeout():
+    fam = _outcome_family()
+    out = _run_monitor([b"executing program 0:\nfoo()\n"],
+                       outcomes=fam, timeout=0.8)
+    assert out.timed_out and not out.crashed
+    assert fam.labels(outcome="timeout").value == 1
+
+
 # -- ci daemon (syz-gce tier analog) ----------------------------------------
 
 

@@ -1,0 +1,59 @@
+#!/usr/bin/env python3
+"""Chaos harness CLI: break a live local fleet on purpose and assert
+zero corpus loss + bounded recovery.
+
+    python tools/chaos.py --smoke       # one SIGKILL/restore cycle
+                                        # (the presubmit gate)
+    python tools/chaos.py --inputs 256  # a bigger storm
+
+Each run SIGKILLs a real manager subprocess mid-admission-storm,
+restarts it, replays the persistent-corpus tail through a fuzzer-shaped
+RPC driver, and verifies the recovered frontier is bit-exact against a
+never-crashed serial replay of the same admitted inputs.  Prints one
+JSON line with the measurements (recovery_seconds etc.); exit code 0
+means every assertion held.
+"""
+
+import argparse
+import json
+import os
+import shutil
+import sys
+import tempfile
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--smoke", action="store_true",
+                    help="single fast kill/restore cycle (presubmit)")
+    ap.add_argument("--inputs", type=int, default=None,
+                    help="NewInput storm size (default 32 smoke, 128 full)")
+    ap.add_argument("--keep", action="store_true",
+                    help="keep the scratch workdirs for inspection")
+    ap.add_argument("-v", action="store_true")
+    args = ap.parse_args(argv)
+
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    from syzkaller_tpu.resilience import chaos
+
+    n = args.inputs or (32 if args.smoke else 128)
+    base = tempfile.mkdtemp(prefix="syz-chaos-")
+    try:
+        out = chaos.run_kill_restore_cycle(base, n_inputs=n,
+                                           verbose=args.v or not args.smoke)
+        out["inputs"] = n
+        print(json.dumps(out))
+        return 0
+    except (AssertionError, TimeoutError) as e:
+        print(json.dumps({"error": str(e)}))
+        return 1
+    finally:
+        if not args.keep:
+            shutil.rmtree(base, ignore_errors=True)
+
+
+if __name__ == "__main__":
+    sys.exit(main())
