@@ -1,0 +1,34 @@
+"""Fleet autopilot: the closed-loop control plane over the telemetry
+registry (ROADMAP L9 — the CONTROL half PR 9's recovery primitives were
+built for).
+
+- health:     per-component state machine (HEALTHY → SUSPECT →
+              DEGRADED → RESTARTING) with hysteresis on both edges.
+- policy:     pure /metrics-sample → signals + typed actions (scale the
+              VM pool against frontier growth vs choice-stream
+              underruns, cluster-aware campaign rotation,
+              snapshot-then-restart for wedged components, backend
+              probe/promote).
+- actions:    token-bucket rate limits + cooldowns per action class and
+              the circuit breaker that trips the controller to
+              observe-only when its own actions correlate with falling
+              health.
+- controller: the supervisor loop, in-process (manager run loop) or
+              remote (tools/autopilot.py scraping /metrics).
+"""
+
+from syzkaller_tpu.autopilot.actions import (
+    Action, ActionLog, CircuitBreaker, RateLimiter, TokenBucket)
+from syzkaller_tpu.autopilot.controller import (
+    Autopilot, HttpSource, ManagerExecutor, RegistrySource,
+    ReportExecutor)
+from syzkaller_tpu.autopilot.health import FleetHealth, HealthMachine, State
+from syzkaller_tpu.autopilot.policy import (
+    Policy, PolicyConfig, SampleView, series_key)
+
+__all__ = [
+    "Action", "ActionLog", "Autopilot", "CircuitBreaker", "FleetHealth",
+    "HealthMachine", "HttpSource", "ManagerExecutor", "Policy",
+    "PolicyConfig", "RateLimiter", "RegistrySource", "ReportExecutor",
+    "SampleView", "State", "TokenBucket", "series_key",
+]

@@ -1,0 +1,194 @@
+"""Typed autopilot actions and the safety layer around them.
+
+Every action class gets a token-bucket rate limit AND a per-class
+cooldown; a restart storm (a flapping health signal proposing the same
+action every tick) drains the bucket and then gets "rate_limited"
+outcomes instead of a second restart.  The global circuit breaker sits
+above both: when the autopilot's own actions correlate with FALLING
+fleet health, it trips the whole controller to observe-only — decisions
+keep being computed and reported, nothing executes — until the trip
+window expires.  A controller that can hurt the fleet must be able to
+take itself offline.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from dataclasses import dataclass
+
+# action kinds (the catalog; README "Fleet autopilot")
+SCALE_UP = "scale_up"          # grow the VM pool / repair lost capacity
+SCALE_DOWN = "scale_down"      # shrink the VM pool
+ROTATE = "rotate"              # move connections toward a campaign
+RESTART = "restart"            # snapshot-then-restart a wedged component
+PROMOTE = "promote"            # probe + promote the quarantined backend
+SNAPSHOT = "snapshot"          # on-demand state snapshot
+
+KINDS = (SCALE_UP, SCALE_DOWN, ROTATE, RESTART, PROMOTE, SNAPSHOT)
+
+# outcomes recorded per attempt (syz_autopilot_actions_total labels)
+FIRED = "fired"
+RATE_LIMITED = "rate_limited"
+OBSERVE_ONLY = "observe_only"
+ERROR = "error"
+NOOP = "noop"
+
+
+@dataclass
+class Action:
+    kind: str
+    component: str = ""         # what it acts on (pool, dstream, campaign)
+    target: "int | str | None" = None   # new pool size / target campaign
+    reason: str = ""
+
+    def describe(self) -> str:
+        t = f" -> {self.target}" if self.target is not None else ""
+        return f"{self.kind}({self.component}{t})"
+
+
+class TokenBucket:
+    """Classic token bucket: `burst` capacity, `rate` tokens/second.
+    Injectable clock for deterministic tests."""
+
+    def __init__(self, rate: float, burst: int, now=None):
+        self.rate = float(rate)
+        self.burst = max(1, int(burst))
+        self._now = now or time.monotonic
+        self._tokens = float(self.burst)
+        self._last = self._now()
+        self._mu = threading.Lock()
+
+    def try_take(self) -> bool:
+        with self._mu:
+            now = self._now()
+            self._tokens = min(self.burst,
+                               self._tokens + (now - self._last) * self.rate)
+            self._last = now
+            if self._tokens >= 1.0:
+                self._tokens -= 1.0
+                return True
+            return False
+
+
+class RateLimiter:
+    """Per-action-class token bucket + cooldown.  The bucket bounds the
+    sustained rate (no restart storms); the cooldown forces a minimum
+    spacing so one tick can never fire the same class twice even with a
+    full bucket."""
+
+    def __init__(self, actions_per_min: float = 6.0, burst: int = 2,
+                 cooldown: float = 10.0, now=None):
+        self._now = now or time.monotonic
+        self._buckets = {k: TokenBucket(actions_per_min / 60.0, burst,
+                                        now=self._now) for k in KINDS}
+        self.cooldown = float(cooldown)
+        self._last_fired: dict[str, float] = {}
+        self._mu = threading.Lock()
+
+    def admit(self, kind: str) -> "str | None":
+        """None = the action may fire; otherwise the refusal outcome."""
+        bucket = self._buckets.get(kind)
+        if bucket is None:
+            return ERROR
+        now = self._now()
+        with self._mu:
+            last = self._last_fired.get(kind)
+            if last is not None and now - last < self.cooldown:
+                return RATE_LIMITED
+        if not bucket.try_take():
+            return RATE_LIMITED
+        with self._mu:
+            self._last_fired[kind] = now
+        return None
+
+
+class CircuitBreaker:
+    """Observe-only trip on INEFFECTIVE repetition: when the same
+    action class has fired at the same component `min_fired` times
+    within the last `window` ticks and that component is STILL not
+    healthy, the autopilot's actions demonstrably aren't helping (a
+    flapping health signal, a restart loop, a probe that keeps
+    "succeeding" into a backend that keeps failing) — stand down to
+    observe-only for `trip_for` seconds.  A recovery that *works*
+    never trips it: each action class fires once, its component goes
+    healthy, the repeat count never accumulates.  While tripped the
+    controller keeps sampling and deciding (decisions show in /healthz
+    and the action counters as observe_only outcomes), so an operator
+    sees what it would have done."""
+
+    def __init__(self, window: int = 8, min_fired: int = 3,
+                 trip_for: float = 120.0, now=None):
+        self.window = max(2, int(window))
+        self.min_fired = max(2, int(min_fired))
+        self.trip_for = float(trip_for)
+        self._now = now or time.monotonic
+        self._mu = threading.Lock()
+        # per tick: list of (kind, component) keys that FIRED
+        self._history: list[list] = []
+        self._tripped_until = 0.0
+        self.trips = 0
+        self.last_trip_reason = ""
+
+    @property
+    def observe_only(self) -> bool:
+        with self._mu:
+            return self._now() < self._tripped_until
+
+    def note_tick(self, fired: "list[tuple[str, str]]",
+                  unhealthy: "set[str]") -> bool:
+        """Record one tick: the (kind, component) pairs that fired and
+        the components currently not HEALTHY.  Returns True when this
+        tick tripped the breaker."""
+        with self._mu:
+            self._history.append(list(fired))
+            if len(self._history) > self.window:
+                self._history.pop(0)
+            if self._now() < self._tripped_until:
+                return False
+            counts: dict = {}
+            for tick in self._history:
+                for key in tick:
+                    counts[key] = counts.get(key, 0) + 1
+            for (kind, component), n in counts.items():
+                if n >= self.min_fired and component in unhealthy:
+                    self._tripped_until = self._now() + self.trip_for
+                    self.trips += 1
+                    self.last_trip_reason = (
+                        f"{kind} fired {n}x at {component} within "
+                        f"{len(self._history)} ticks and it is still "
+                        "unhealthy")
+                    self._history.clear()
+                    return True
+            return False
+
+    def reset(self) -> None:
+        with self._mu:
+            self._tripped_until = 0.0
+            self._history.clear()
+
+
+class ActionLog:
+    """Bounded ring of attempted actions for /healthz and the remote
+    CLI report."""
+
+    def __init__(self, cap: int = 64):
+        self.cap = cap
+        self._mu = threading.Lock()
+        self._entries: list[dict] = []
+
+    def record(self, action: Action, outcome: str,
+               detail: str = "") -> None:
+        with self._mu:
+            self._entries.append({
+                "ts": time.time(), "action": action.kind,
+                "component": action.component,
+                "target": action.target, "outcome": outcome,
+                "reason": action.reason, "detail": detail,
+            })
+            if len(self._entries) > self.cap:
+                self._entries.pop(0)
+
+    def snapshot(self, n: int = 16) -> list:
+        with self._mu:
+            return list(self._entries[-n:])

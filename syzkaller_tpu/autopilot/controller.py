@@ -1,0 +1,287 @@
+"""The autopilot supervisor loop: sample → health → decide → act.
+
+One `Autopilot` closes the control loop the ROADMAP's L9 item names:
+it samples the telemetry plane on an `autopilot_interval` cadence, runs
+every fleet component through the health state machine, and executes
+typed, rate-limited actions through the PR 9 recovery seams.  Two
+deployment shapes share the code:
+
+  * in-process: `Autopilot.for_manager(mgr)` — `RegistrySource` samples
+    the manager's own /metrics text, `ManagerExecutor` acts through the
+    manager's seams (VM pool resize, campaign rotation, snapshot-then-
+    restart, backend probe).  The manager run loop drives
+    `maybe_tick()`.
+  * remote: `tools/autopilot.py` — `HttpSource` scrapes a manager's
+    /metrics over HTTP, `ReportExecutor` records what WOULD fire
+    (observe-only: a remote controller has no seams to act through),
+    so the same policy powers external dashboards and the gce tier.
+
+Safety: every action class is token-bucket rate limited with a
+cooldown (actions.RateLimiter), and the circuit breaker trips the whole
+controller to observe-only when its own actions correlate with falling
+fleet health.  The autopilot never holds a manager lock; every seam it
+calls takes its own locks exactly like an RPC handler would.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+from syzkaller_tpu.autopilot.actions import (
+    ERROR, FIRED, NOOP, OBSERVE_ONLY, PROMOTE, RESTART, ROTATE, SCALE_DOWN,
+    SCALE_UP, SNAPSHOT, Action, ActionLog, CircuitBreaker, RateLimiter)
+from syzkaller_tpu.autopilot.health import FleetHealth, State
+from syzkaller_tpu.autopilot.policy import Policy, PolicyConfig, SampleView
+from syzkaller_tpu.utils import log
+
+
+# -- metric sources ----------------------------------------------------------
+
+
+class RegistrySource:
+    """In-process sampling: the manager's Prometheus text parsed back
+    into {series: value}.  Going through the exposition (instead of
+    poking registry objects) keeps the in-process and remote policies
+    literally identical."""
+
+    def __init__(self, manager):
+        self.mgr = manager
+
+    def sample(self) -> dict:
+        from syzkaller_tpu.telemetry import expo
+        return expo.parse_prometheus_text(self.mgr.metrics_text())
+
+
+class HttpSource:
+    """Remote sampling: GET a manager's /metrics endpoint."""
+
+    def __init__(self, url: str, timeout: float = 10.0):
+        self.url = url
+        self.timeout = timeout
+
+    def sample(self) -> dict:
+        import urllib.request
+
+        from syzkaller_tpu.telemetry import expo
+        with urllib.request.urlopen(self.url,
+                                    timeout=self.timeout) as resp:
+            return expo.parse_prometheus_text(resp.read().decode())
+
+
+# -- executors ---------------------------------------------------------------
+
+
+class ManagerExecutor:
+    """Acts through the manager's recovery seams.  Every branch returns
+    (outcome, detail); exceptions become ERROR outcomes — a failed
+    action must never take the control loop down with it."""
+
+    def __init__(self, manager):
+        self.mgr = manager
+
+    def execute(self, action: Action) -> "tuple[str, str]":
+        try:
+            return self._execute(action)
+        except Exception as e:
+            log.logf(0, "autopilot action %s failed: %s",
+                     action.describe(), e)
+            return ERROR, str(e)
+
+    def _execute(self, action: Action) -> "tuple[str, str]":
+        mgr = self.mgr
+        if action.kind == PROMOTE:
+            probe = getattr(mgr.engine, "probe", None)
+            if probe is None or not getattr(mgr.engine, "degraded", False):
+                return NOOP, "backend not degraded"
+            promoted = probe()
+            return FIRED, ("promoted" if promoted
+                           else "probe failed; still quarantined")
+        if action.kind in (SCALE_UP, SCALE_DOWN):
+            got = mgr.scale_vms(int(action.target))
+            return FIRED, f"pool target {got}"
+        if action.kind == RESTART:
+            mgr.restart_component(str(action.target))
+            return FIRED, f"snapshot + restart {action.target}"
+        if action.kind == ROTATE:
+            moved = mgr.rotate_campaign(action.component,
+                                        str(action.target))
+            if not moved:
+                return NOOP, "no live connection on the campaign"
+            return FIRED, f"rotated {','.join(moved)}"
+        if action.kind == SNAPSHOT:
+            path = mgr.checkpointer.snapshot_now()
+            return (FIRED, path or "") if path else (ERROR,
+                                                     "snapshot failed")
+        return ERROR, f"unknown action kind {action.kind!r}"
+
+
+class ReportExecutor:
+    """Remote observe mode: nothing executes, every decision is
+    reported as observe_only.  `acts = False` tells the controller to
+    skip the rate limiter — limits gate execution, not reporting."""
+
+    acts = False
+
+    def execute(self, action: Action) -> "tuple[str, str]":
+        return OBSERVE_ONLY, "remote observe mode"
+
+
+# -- the controller ----------------------------------------------------------
+
+
+class Autopilot:
+    def __init__(self, source, executor, interval: float = 5.0,
+                 policy: "Policy | None" = None,
+                 limiter: "RateLimiter | None" = None,
+                 breaker: "CircuitBreaker | None" = None,
+                 registry=None, now=None):
+        self.source = source
+        self.executor = executor
+        self.interval = float(interval)
+        self.policy = policy or Policy()
+        self.limiter = limiter or RateLimiter(now=now)
+        self.breaker = breaker or CircuitBreaker(now=now)
+        self.health = FleetHealth(now=now)
+        self.log = ActionLog()
+        self._now = now or time.monotonic
+        self._last_tick = 0.0
+        self._prev_sample: "dict | None" = None
+        self._mu = threading.Lock()      # one tick at a time
+        self.stat_ticks = 0
+        self._c_ticks = self._f_actions = self._g_health = None
+        self._c_trips = None
+        if registry is not None:
+            self._register(registry)
+
+    @classmethod
+    def for_manager(cls, manager, cfg) -> "Autopilot":
+        """The in-process autopilot a manager owns, parameterized from
+        its validated config."""
+        policy = Policy(PolicyConfig(
+            snapshot_interval=cfg.snapshot_interval,
+            min_vms=cfg.autopilot_min_vms,
+            max_vms=cfg.autopilot_max_vms,
+            flat_cov=(cfg.campaign_rotation
+                      if cfg.campaign_rotation > 0 else 0.5),
+        ))
+        return cls(RegistrySource(manager), ManagerExecutor(manager),
+                   interval=cfg.autopilot_interval, policy=policy,
+                   limiter=RateLimiter(
+                       actions_per_min=cfg.autopilot_actions_per_min,
+                       burst=cfg.autopilot_burst,
+                       cooldown=cfg.autopilot_cooldown),
+                   registry=manager.registry)
+
+    def _register(self, registry) -> None:
+        self._c_ticks = registry.counter(
+            "syz_autopilot_ticks_total", "autopilot control-loop ticks")
+        self._f_actions = registry.counter(
+            "syz_autopilot_actions_total",
+            "autopilot actions by class and outcome",
+            labels=("action", "outcome"))
+        self._g_health = registry.gauge(
+            "syz_autopilot_health",
+            "per-component health state (0=HEALTHY 1=SUSPECT "
+            "2=DEGRADED 3=RESTARTING)", labels=("component",))
+        registry.gauge(
+            "syz_autopilot_observe_only",
+            "1 while the circuit breaker holds the autopilot in "
+            "observe-only mode",
+            fn=lambda: 1.0 if self.breaker.observe_only else 0.0)
+        self._c_trips = registry.counter(
+            "syz_autopilot_breaker_trips_total",
+            "circuit-breaker trips to observe-only")
+
+    # -- ticking -----------------------------------------------------------
+
+    def maybe_tick(self, now: "float | None" = None) -> "dict | None":
+        """Run-loop cadence entry: ticks at most every `interval`."""
+        now = self._now() if now is None else now
+        if now - self._last_tick < self.interval:
+            return None
+        self._last_tick = now
+        return self.tick()
+
+    def tick(self) -> dict:
+        """One full control-loop pass; returns the tick report (the
+        remote CLI prints it)."""
+        with self._mu:
+            return self._tick_locked()
+
+    def _tick_locked(self) -> dict:
+        sample = self.source.sample()
+        view = SampleView(sample, self._prev_sample)
+        self._prev_sample = sample
+        self.stat_ticks += 1
+        if self._c_ticks is not None:
+            self._c_ticks.inc()
+        for comp, ok, reason in self.policy.evaluate(view):
+            fresh = comp not in self.health.machines
+            self.health.observe(comp, ok, reason)
+            if fresh and self._g_health is not None:
+                self._g_health.labels(component=comp).set_function(
+                    lambda c=comp: float(int(self.health.state(c))))
+        proposed = self.policy.decide(self.health, view)
+        observe = self.breaker.observe_only
+        fired: "list[tuple[str, str]]" = []
+        results = []
+        for a in proposed:
+            if observe:
+                outcome, detail = OBSERVE_ONLY, "circuit breaker tripped"
+            elif not getattr(self.executor, "acts", True):
+                outcome, detail = self.executor.execute(a)
+            else:
+                refusal = self.limiter.admit(a.kind)
+                if refusal is not None:
+                    outcome, detail = refusal, "rate limit / cooldown"
+                else:
+                    outcome, detail = self.executor.execute(a)
+                    if outcome == FIRED:
+                        fired.append((a.kind, a.component))
+                        log.logf(0, "autopilot: %s (%s) -> %s",
+                                 a.describe(), a.reason, detail)
+                        if a.kind == RESTART:
+                            self.health.machine(
+                                a.component).mark_restarting()
+            if self._f_actions is not None:
+                self._f_actions.labels(action=a.kind,
+                                       outcome=outcome).inc()
+            self.log.record(a, outcome, detail)
+            results.append({"action": a.kind, "component": a.component,
+                            "target": a.target, "outcome": outcome,
+                            "reason": a.reason, "detail": detail})
+        score = self.health.score()
+        unhealthy = {name for name, m in self.health.machines.items()
+                     if m.state is not State.HEALTHY}
+        if self.breaker.note_tick(fired, unhealthy):
+            if self._c_trips is not None:
+                self._c_trips.inc()
+            log.logf(0, "autopilot circuit breaker TRIPPED "
+                     "(%s); observe-only for %.0fs",
+                     self.breaker.last_trip_reason, self.breaker.trip_for)
+        return {
+            "ts": time.time(),
+            "score": round(score, 3),
+            "observe_only": self.breaker.observe_only,
+            "components": self.health.snapshot(),
+            "actions": results,
+        }
+
+    # -- /healthz ----------------------------------------------------------
+
+    def health_json(self) -> "tuple[int, dict]":
+        """(http status, body) for the /healthz endpoint: 200 while no
+        component is DEGRADED/RESTARTING, 503 otherwise — the probe
+        contract external orchestrators (k8s-style, the gce tier) key
+        on."""
+        worst = self.health.worst()
+        code = 200 if worst < State.DEGRADED else 503
+        return code, {
+            "status": "ok" if code == 200 else "degraded",
+            "observe_only": self.breaker.observe_only,
+            "score": round(self.health.score(), 3),
+            "ticks": self.stat_ticks,
+            "components": self.health.snapshot(),
+            "recent_actions": self.log.snapshot(),
+        }

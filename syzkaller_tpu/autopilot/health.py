@@ -1,0 +1,156 @@
+"""Per-component health state machine with hysteresis on both edges.
+
+Every fleet component the autopilot watches (the device backend, the
+decision stream, the admission plane, the VM pool, each campaign, the
+snapshot cadence) runs through the same explicit state machine:
+
+    HEALTHY --bad*S--> SUSPECT --bad*D--> DEGRADED
+    HEALTHY <--good*R-- SUSPECT <--good*R-- DEGRADED
+                 RESTARTING --good*R--> HEALTHY
+                 RESTARTING --bad*(G+D)--> DEGRADED
+
+Transitions fire on observation STREAKS, never on a single sample:
+one noisy scrape must not flap a component into DEGRADED (which would
+trigger actions) and one lucky scrape must not clear it (which would
+cancel a recovery mid-flight).  RESTARTING is entered externally when
+the controller fires a restart-class action at the component; it gets
+a grace window of `restart_grace` bad observations before it can fall
+back to DEGRADED (a component mid-restart legitimately looks dead).
+
+The machine is deliberately time-free: it counts *observations*, and
+the controller's tick cadence (`autopilot_interval`) supplies the
+clock.  `now` timestamps are carried only for the /healthz report.
+"""
+
+from __future__ import annotations
+
+import enum
+import time
+
+
+class State(enum.IntEnum):
+    HEALTHY = 0
+    SUSPECT = 1
+    DEGRADED = 2
+    RESTARTING = 3
+
+
+class HealthMachine:
+    """One component's state machine.
+
+    `suspect_after`  bad observations take HEALTHY -> SUSPECT,
+    `degrade_after`  further bad observations take SUSPECT -> DEGRADED,
+    `recover_after`  good observations step DEGRADED -> SUSPECT and
+                     SUSPECT/RESTARTING -> HEALTHY (the down edge has
+                     hysteresis too: DEGRADED never jumps straight to
+                     HEALTHY).
+    """
+
+    def __init__(self, name: str, suspect_after: int = 2,
+                 degrade_after: int = 2, recover_after: int = 3,
+                 restart_grace: int = 4, now=None):
+        self.name = name
+        self.suspect_after = max(1, int(suspect_after))
+        self.degrade_after = max(1, int(degrade_after))
+        self.recover_after = max(1, int(recover_after))
+        self.restart_grace = max(0, int(restart_grace))
+        self._now = now or time.monotonic
+        self.state = State.HEALTHY
+        self.since = self._now()
+        self.reason = ""
+        self._bad_streak = 0
+        self._good_streak = 0
+        self.transitions = 0
+
+    def _enter(self, state: State, reason: str = "") -> None:
+        if state is self.state:
+            return
+        self.state = state
+        self.since = self._now()
+        self.reason = reason
+        self._bad_streak = 0
+        self._good_streak = 0
+        self.transitions += 1
+
+    def observe(self, ok: bool, reason: str = "") -> State:
+        """Fold one health observation; returns the (possibly new)
+        state.  `reason` is kept for the /healthz report while the
+        observation is bad."""
+        if ok:
+            self._good_streak += 1
+            self._bad_streak = 0
+            if self._good_streak >= self.recover_after:
+                if self.state is State.DEGRADED:
+                    self._enter(State.SUSPECT, "recovering")
+                elif self.state in (State.SUSPECT, State.RESTARTING):
+                    self._enter(State.HEALTHY)
+            return self.state
+        self._bad_streak += 1
+        self._good_streak = 0
+        self.reason = reason or self.reason
+        if self.state is State.HEALTHY:
+            if self._bad_streak >= self.suspect_after:
+                self._enter(State.SUSPECT, self.reason)
+        elif self.state is State.SUSPECT:
+            if self._bad_streak >= self.degrade_after:
+                self._enter(State.DEGRADED, self.reason)
+        elif self.state is State.RESTARTING:
+            if self._bad_streak >= self.restart_grace + self.degrade_after:
+                self._enter(State.DEGRADED,
+                            self.reason or "restart did not take")
+        return self.state
+
+    def mark_restarting(self) -> None:
+        """The controller fired a restart-class action at this
+        component: expect it to look dead for a grace window."""
+        self._enter(State.RESTARTING, "restart action fired")
+
+    def snapshot(self) -> dict:
+        return {
+            "state": self.state.name,
+            "since": round(self._now() - self.since, 3),
+            "reason": self.reason if self.state is not State.HEALTHY else "",
+            "transitions": self.transitions,
+        }
+
+
+class FleetHealth:
+    """The machines for every watched component, created on first
+    observation (campaigns appear and disappear with config)."""
+
+    def __init__(self, now=None, **machine_kwargs):
+        self._now = now or time.monotonic
+        self._kwargs = machine_kwargs
+        self.machines: dict[str, HealthMachine] = {}
+
+    def machine(self, component: str) -> HealthMachine:
+        m = self.machines.get(component)
+        if m is None:
+            m = self.machines[component] = HealthMachine(
+                component, now=self._now, **self._kwargs)
+        return m
+
+    def observe(self, component: str, ok: bool, reason: str = "") -> State:
+        return self.machine(component).observe(ok, reason)
+
+    def state(self, component: str) -> State:
+        m = self.machines.get(component)
+        return m.state if m is not None else State.HEALTHY
+
+    def score(self) -> float:
+        """Fleet badness in [0, 3]: mean numeric state over components
+        (0 = everything HEALTHY).  The circuit breaker compares this
+        before/after its own actions."""
+        if not self.machines:
+            return 0.0
+        return sum(int(m.state) for m in self.machines.values()) \
+            / len(self.machines)
+
+    def worst(self) -> State:
+        if not self.machines:
+            return State.HEALTHY
+        return State(max(int(m.state) for m in self.machines.values()))
+
+    def snapshot(self) -> dict:
+        return {name: m.snapshot()
+                for name, m in sorted(self.machines.items())}

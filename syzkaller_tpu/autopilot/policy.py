@@ -1,0 +1,266 @@
+"""Autopilot policy: metric samples in, health signals + actions out.
+
+The policy is a pure function of two consecutive /metrics samples plus
+the fleet's health machines — no hidden channels into the manager, so
+the SAME policy runs in-process (registry sampling) and remotely
+(`tools/autopilot.py` scraping /metrics over HTTP).  Everything it
+needs is a first-class telemetry series:
+
+  syz_backend_degraded              device backend quarantined?
+  syz_choice_*                      decision-stream draw/underrun counters
+  syz_admission_*                   admission inputs + shed counters
+  syz_vm_pool_live / _target        pool capacity vs intent
+  syz_new_cov_per_1k_exec{campaign} frontier productivity (EWMA)
+  syz_campaign_cluster_rate{...}    crash-cluster growth per campaign
+  syz_campaign_assigned{...}        connections fuzzing each campaign
+  syz_snapshot_age_seconds          crash-only persistence freshness
+
+Scaling discipline: VMs are added only while the decision stream keeps
+up (`choice underrun rate` below `scale_underrun_limit`) — adding VMs
+the stream can't feed just converts capacity into underruns.  Rotation
+is cluster-aware: a wedged campaign (flat frontier, no cluster growth,
+fleet still executing) rotates TOWARD the campaign whose crash clusters
+are still growing, not merely to the next name in the list.
+"""
+
+from __future__ import annotations
+
+import re
+from dataclasses import dataclass
+
+from syzkaller_tpu.autopilot.actions import (
+    PROMOTE, RESTART, ROTATE, SCALE_DOWN, SCALE_UP, SNAPSHOT, Action)
+from syzkaller_tpu.autopilot.health import FleetHealth, State
+
+_LABEL_RE = re.compile(r'(\w+)="((?:[^"\\]|\\.)*)"')
+
+
+def series_key(name: str, **labels) -> str:
+    """The exposition-line key for a labeled series (matches
+    telemetry/expo.py's sorted-label formatting)."""
+    if not labels:
+        return name
+    inner = ",".join(f'{k}="{v}"' for k, v in sorted(labels.items()))
+    return f"{name}{{{inner}}}"
+
+
+class SampleView:
+    """Two consecutive {series-key: value} samples with the lookups the
+    policy needs: point values, family label enumeration, and counter
+    deltas across the tick."""
+
+    def __init__(self, cur: dict, prev: "dict | None" = None):
+        self.cur = cur
+        self.prev = prev or {}
+
+    def value(self, name: str, default=None, **labels):
+        return self.cur.get(series_key(name, **labels), default)
+
+    def _sum_prefix(self, sample: dict, name: str) -> "float | None":
+        total, found = 0.0, False
+        brace = name + "{"
+        for k, v in sample.items():
+            if k == name or k.startswith(brace):
+                total += v
+                found = True
+        return total if found else None
+
+    def sum_prefix(self, name: str, default=0.0) -> float:
+        got = self._sum_prefix(self.cur, name)
+        return default if got is None else got
+
+    def delta(self, name: str) -> float:
+        """Counter increase across the tick (0 on the first sample or
+        after a counter reset)."""
+        cur = self._sum_prefix(self.cur, name)
+        prev = self._sum_prefix(self.prev, name)
+        if cur is None or prev is None:
+            return 0.0
+        return max(0.0, cur - prev)
+
+    def family(self, name: str, label: str) -> "list[str]":
+        """Distinct values of `label` across the family's series."""
+        out = []
+        brace = name + "{"
+        for k in self.cur:
+            if not k.startswith(brace):
+                continue
+            for lk, lv in _LABEL_RE.findall(k[len(brace):-1]):
+                if lk == label and lv not in out:
+                    out.append(lv)
+        return out
+
+
+@dataclass
+class PolicyConfig:
+    # health limits
+    underrun_limit: float = 0.5     # choice-stream underrun fraction
+    shed_limit: float = 0.5         # admission shed fraction
+    snapshot_interval: float = 0.0  # manager cadence (0 = unwatched);
+    #                                 DEGRADED past 3x this age
+    # elastic scaling (0 = that direction disabled; repair-to-target
+    # always stays on)
+    min_vms: int = 0
+    max_vms: int = 0
+    scale_up_cov: float = 1.0       # fleet new_cov_per_1k demand floor
+    scale_down_cov: float = 0.01    # below this → capacity is idle
+    scale_underrun_limit: float = 0.2   # never add VMs past this
+    scale_down_ticks: int = 6       # consecutive idle ticks before shrink
+    # campaign rotation
+    flat_cov: float = 0.5           # wedged-frontier threshold
+    exec_floor: float = 0.1         # fleet exec_rate below this = idle,
+    #                                 nothing is "wedged", it's just off
+
+
+class Policy:
+    def __init__(self, config: "PolicyConfig | None" = None):
+        self.cfg = config or PolicyConfig()
+        self._idle_ticks = 0
+
+    # -- derived rates -----------------------------------------------------
+
+    def underrun_rate(self, view: SampleView) -> float:
+        draws = (view.delta("syz_choice_draws_total")
+                 + view.delta("syz_choice_topup_total")
+                 + view.delta("syz_choice_ring_served_total"))
+        if draws <= 0:
+            return 0.0
+        return view.delta("syz_choice_ring_underrun_total") / draws
+
+    def shed_rate(self, view: SampleView) -> float:
+        inputs = view.delta("syz_admission_inputs_total")
+        if inputs <= 0:
+            return 0.0
+        return view.delta("syz_admission_shed_total") / inputs
+
+    # -- health signals ----------------------------------------------------
+
+    def evaluate(self, view: SampleView) -> "list[tuple[str, bool, str]]":
+        cfg = self.cfg
+        sig: list[tuple[str, bool, str]] = []
+        degraded = view.value("syz_backend_degraded", 0.0) or 0.0
+        sig.append(("backend", degraded < 0.5,
+                    "device backend quarantined (CPU fallback)"))
+        ur = self.underrun_rate(view)
+        sig.append(("choices", ur <= cfg.underrun_limit,
+                    f"choice-stream underrun rate {ur:.2f}"))
+        sr = self.shed_rate(view)
+        sig.append(("admission", sr <= cfg.shed_limit,
+                    f"admission shed rate {sr:.2f}"))
+        live = view.value("syz_vm_pool_live")
+        target = view.value("syz_vm_pool_target")
+        if target is not None and target > 0:
+            short = live is None or live < target
+            sig.append(("vm_pool", not short,
+                        f"pool {0 if live is None else int(live)}"
+                        f"/{int(target)} VM threads live"))
+        if cfg.snapshot_interval > 0:
+            age = view.value("syz_snapshot_age_seconds")
+            stale = age is not None and age > 3 * cfg.snapshot_interval
+            sig.append(("snapshot", not stale,
+                        f"snapshot age {0 if age is None else age:.0f}s"))
+        exec_rate = view.value("syz_exec_rate", 0.0) or 0.0
+        for camp in view.family("syz_new_cov_per_1k_exec", "campaign"):
+            if camp == "all":
+                continue
+            assigned = view.value("syz_campaign_assigned", 0.0,
+                                  campaign=camp) or 0.0
+            if assigned <= 0:
+                # nobody is fuzzing it: not wedged, just unscheduled
+                sig.append((f"campaign:{camp}", True, ""))
+                continue
+            cov = view.value("syz_new_cov_per_1k_exec", 0.0,
+                             campaign=camp) or 0.0
+            clusters = view.value("syz_campaign_cluster_rate", 0.0,
+                                  campaign=camp) or 0.0
+            wedged = (exec_rate > cfg.exec_floor and cov < cfg.flat_cov
+                      and clusters <= 0.0)
+            sig.append((f"campaign:{camp}", not wedged,
+                        f"flat frontier ({cov:.2f} new cov/1k execs, "
+                        "no cluster growth)"))
+        return sig
+
+    # -- decisions ---------------------------------------------------------
+
+    def rotation_target(self, view: SampleView, exclude: str
+                        ) -> "str | None":
+        """The campaign to rotate TOWARD: highest crash-cluster growth
+        rate first (still-moving subsystems), frontier productivity as
+        the tie-breaker."""
+        best, best_score = None, None
+        for camp in view.family("syz_new_cov_per_1k_exec", "campaign"):
+            if camp in ("all", exclude):
+                continue
+            score = (view.value("syz_campaign_cluster_rate", 0.0,
+                                campaign=camp) or 0.0,
+                     view.value("syz_new_cov_per_1k_exec", 0.0,
+                                campaign=camp) or 0.0)
+            if best_score is None or score > best_score:
+                best, best_score = camp, score
+        return best
+
+    def decide(self, health: FleetHealth, view: SampleView
+               ) -> "list[Action]":
+        cfg = self.cfg
+        actions: list[Action] = []
+        if health.state("backend") >= State.SUSPECT \
+                and (view.value("syz_backend_degraded", 0.0) or 0.0) > 0.5:
+            actions.append(Action(PROMOTE, "backend",
+                                  reason="probe quarantined device backend"))
+        live = view.value("syz_vm_pool_live")
+        target = view.value("syz_vm_pool_target")
+        ur = self.underrun_rate(view)
+        cov = view.value("syz_new_cov_per_1k_exec", 0.0,
+                         campaign="all") or 0.0
+        exec_rate = view.value("syz_exec_rate", 0.0) or 0.0
+        if target is not None and target > 0:
+            target = int(target)
+            live = int(live or 0)
+            if live < target and health.state("vm_pool") >= State.SUSPECT:
+                actions.append(Action(
+                    SCALE_UP, "vm_pool", target=target,
+                    reason=f"restore capacity ({live}/{target} live)"))
+            elif live >= target \
+                    and health.state("vm_pool") is State.HEALTHY:
+                idle = exec_rate > cfg.exec_floor \
+                    and cov < cfg.scale_down_cov
+                self._idle_ticks = self._idle_ticks + 1 if idle else 0
+                if 0 < cfg.max_vms and target < cfg.max_vms \
+                        and cov >= cfg.scale_up_cov \
+                        and ur < cfg.scale_underrun_limit:
+                    actions.append(Action(
+                        SCALE_UP, "vm_pool", target=target + 1,
+                        reason=f"frontier productive ({cov:.1f} "
+                               f"cov/1k) and stream keeping up "
+                               f"(underrun {ur:.2f})"))
+                elif 0 < cfg.min_vms < target \
+                        and self._idle_ticks >= cfg.scale_down_ticks:
+                    actions.append(Action(
+                        SCALE_DOWN, "vm_pool", target=target - 1,
+                        reason=f"frontier flat for {self._idle_ticks} "
+                               "ticks"))
+        for comp, seam in (("choices", "dstream"),
+                           ("admission", "coalescer")):
+            if health.state(comp) is State.DEGRADED:
+                actions.append(Action(
+                    RESTART, comp, target=seam,
+                    reason=f"{comp} plane wedged (snapshot, then "
+                           "restart)"))
+        for name, m in health.machines.items():
+            if not name.startswith("campaign:") \
+                    or m.state is not State.DEGRADED:
+                continue
+            camp = name.split(":", 1)[1]
+            assigned = view.value("syz_campaign_assigned", 0.0,
+                                  campaign=camp) or 0.0
+            if assigned <= 0:
+                continue     # already rotated off; let the machine heal
+            to = self.rotation_target(view, exclude=camp)
+            if to is not None:
+                actions.append(Action(
+                    ROTATE, camp, target=to,
+                    reason="rotate toward growing crash clusters"))
+        if health.state("snapshot") is State.DEGRADED:
+            actions.append(Action(SNAPSHOT, "snapshot",
+                                  reason="snapshot cadence stalled"))
+        return actions

@@ -51,6 +51,10 @@ class _Rates:
 class CampaignScheduler:
     """Round-robin assignment + decay-triggered rotation."""
 
+    # crash-cluster growth EWMA horizon: clusters arrive much slower
+    # than coverage, so the growth signal needs a longer memory
+    CLUSTER_TAU = 600.0
+
     def __init__(self, campaigns: "list[str]", rotation: float = 0.0,
                  min_execs: int = 2000, tau: float = 120.0,
                  registry=None, now=None):
@@ -67,22 +71,77 @@ class CampaignScheduler:
         self._tau = tau
         self._tags: dict[str, list[str]] = {c: [] for c in self.campaigns}
         self._tags_dirty = False
+        # cluster-aware rotation state: distinct crash-cluster ids each
+        # campaign has produced, and the growth rate of that set (a
+        # campaign whose clusters are still growing is still FINDING
+        # bugs even when its coverage frontier reads flat)
+        self._cluster_ids: dict[str, set] = {c: set()
+                                             for c in self.campaigns}
+        self._cluster_rates: dict[str, EwmaRate] = {
+            c: EwmaRate("clusters", tau=self.CLUSTER_TAU)
+            for c in self.campaigns}
         self.stat_rotations = 0
         self._c_rotations = None
+        self._registry = None
         if registry is not None:
             self._register(registry)
 
     def _register(self, registry) -> None:
+        self._registry = registry
         fam = registry.gauge(
             "syz_new_cov_per_1k_exec",
             "new coverage bits admitted per 1000 execs (EWMA; the "
             "campaign-rotation trigger)", labels=("campaign",))
+        cfam = registry.gauge(
+            "syz_campaign_cluster_rate",
+            "distinct new crash clusters per second (EWMA; campaigns "
+            "with growing clusters are rotation TARGETS)",
+            labels=("campaign",))
+        afam = registry.gauge(
+            "syz_campaign_assigned",
+            "fuzzer connections currently assigned to each campaign",
+            labels=("campaign",))
         for name in [GLOBAL] + self.campaigns:
             g = fam.labels(campaign=name)
             g.set_function(lambda n=name: self.new_cov_per_1k_exec(n))
+        for name in self.campaigns:
+            cfam.labels(campaign=name).set_function(
+                lambda n=name: self.cluster_rate(n))
+            afam.labels(campaign=name).set_function(
+                lambda n=name: float(self.assigned_count(n)))
         self._c_rotations = registry.counter(
             "syz_campaign_rotations_total",
             "connections rotated off a decayed campaign")
+
+    def register_campaign(self, name: str) -> None:
+        """Add a campaign to the rotation set at runtime (tests, the
+        chaos harness, and future dynamic description loading); a
+        no-op when already registered."""
+        with self._mu:
+            if name in self._rates:
+                return
+            self.campaigns.append(name)
+            self._rates[name] = _Rates(self._tau)
+            self._tags[name] = []
+            self._cluster_ids[name] = set()
+            self._cluster_rates[name] = EwmaRate(
+                "clusters", tau=self.CLUSTER_TAU)
+        if self._registry is not None:
+            self._registry.gauge(
+                "syz_new_cov_per_1k_exec",
+                labels=("campaign",)).labels(
+                campaign=name).set_function(
+                lambda n=name: self.new_cov_per_1k_exec(n))
+            self._registry.gauge(
+                "syz_campaign_cluster_rate",
+                labels=("campaign",)).labels(
+                campaign=name).set_function(
+                lambda n=name: self.cluster_rate(n))
+            self._registry.gauge(
+                "syz_campaign_assigned",
+                labels=("campaign",)).labels(
+                campaign=name).set_function(
+                lambda n=name: float(self.assigned_count(n)))
 
     # -- assignment --------------------------------------------------------
 
@@ -104,9 +163,25 @@ class CampaignScheduler:
         with self._mu:
             return self._assigned.get(conn)
 
+    def assigned_count(self, campaign: str) -> int:
+        with self._mu:
+            return sum(1 for c in self._assigned.values() if c == campaign)
+
     def drop(self, conn: str) -> None:
+        """Return a (reaped) connection's campaign assignment to the
+        pool.  Idempotent: a concurrent rotation in the same tick can
+        never resurrect the assignment (rotate_toward only MOVES
+        existing assignments, it never creates one), so the slot frees
+        exactly once."""
         with self._mu:
             self._assigned.pop(conn, None)
+
+    def force_assign(self, conn: str, campaign: str) -> None:
+        """Pin a connection to a campaign (tests + the chaos harness;
+        production assignment goes through assign()/rotation)."""
+        with self._mu:
+            if campaign in self._rates:
+                self._assigned[conn] = campaign
 
     # -- accounting --------------------------------------------------------
 
@@ -142,6 +217,34 @@ class CampaignScheduler:
                 r.cov_total += bits
                 r.cov.add(bits, now=now)
 
+    def note_cluster(self, conn: "str | None", cluster_id: str) -> None:
+        """Attribute a crash cluster to the campaign the crashing VM's
+        connection is fuzzing.  Only a cluster NEW to that campaign
+        bumps its growth rate — repeats of a known cluster are noise,
+        a fresh cluster means the subsystem still has unexplored bug
+        surface (what the autopilot rotates toward)."""
+        if not cluster_id:
+            return
+        now = self._now()
+        with self._mu:
+            camp = self._assigned.get(conn) if conn else None
+            if camp is None or camp not in self._cluster_ids:
+                return
+            if cluster_id in self._cluster_ids[camp]:
+                return
+            self._cluster_ids[camp].add(cluster_id)
+            rate = self._cluster_rates[camp]
+        rate.add(1, now=now)
+
+    def cluster_rate(self, campaign: str) -> float:
+        with self._mu:
+            r = self._cluster_rates.get(campaign)
+        return r.rate(self._now()) if r is not None else 0.0
+
+    def clusters(self, campaign: str) -> "set[str]":
+        with self._mu:
+            return set(self._cluster_ids.get(campaign, ()))
+
     def new_cov_per_1k_exec(self, campaign: str = GLOBAL) -> float:
         with self._mu:
             r = self._rates.get(campaign)
@@ -149,11 +252,36 @@ class CampaignScheduler:
 
     # -- rotation ----------------------------------------------------------
 
+    def _pick_target_locked(self, exclude: str, now: float) -> str:
+        """The campaign to rotate TOWARD (caller holds _mu): highest
+        crash-cluster growth rate first — a subsystem whose clusters
+        are still growing has live bug surface even with a flat
+        coverage frontier — frontier productivity as the tie-breaker,
+        round-robin order as the final fallback."""
+        best, best_score = None, None
+        for i, c in enumerate(self.campaigns):
+            if c == exclude:
+                continue
+            rr = self._rates.get(c)
+            score = (self._cluster_rates[c].rate(now)
+                     if c in self._cluster_rates else 0.0,
+                     rr.per_1k(now) if rr is not None else 0.0,
+                     -i)           # stable fallback: list order
+            if best_score is None or score > best_score:
+                best, best_score = c, score
+        if best is not None and best_score[:2] != (0.0, 0.0):
+            return best
+        # nothing is measurably better: plain round-robin next
+        i = self.campaigns.index(exclude)
+        return self.campaigns[(i + 1) % len(self.campaigns)]
+
     def maybe_rotate(self, conn: str) -> "str | None":
-        """Rotate `conn` to the next campaign when its current one has
+        """Rotate `conn` off its campaign when that campaign has
         decayed: enough execs observed AND new_cov_per_1k_exec below
-        the threshold.  Returns the new assignment (None = unchanged).
-        Called per Poll — cheap (two EWMA reads)."""
+        the threshold.  The target is cluster-aware (toward growing
+        crash clusters, not merely the next name).  Returns the new
+        assignment (None = unchanged).  Called per Poll — cheap (a few
+        EWMA reads)."""
         if not self.campaigns or self.rotation <= 0.0 \
                 or len(self.campaigns) < 2:
             return None
@@ -167,8 +295,7 @@ class CampaignScheduler:
                 return None
             if r.per_1k(now) >= self.rotation:
                 return None
-            i = self.campaigns.index(camp)
-            nxt = self.campaigns[(i + 1) % len(self.campaigns)]
+            nxt = self._pick_target_locked(camp, now)
             self._assigned[conn] = nxt
             # fresh productivity window for the incoming campaign on
             # this connection: its own EWMA keeps history, but the
@@ -183,6 +310,37 @@ class CampaignScheduler:
                  "(new_cov_per_1k_exec decayed below %.3g)",
                  conn, camp, nxt, self.rotation)
         return nxt
+
+    def rotate_toward(self, frm: str, to: str,
+                      conns: "list[str] | None" = None) -> "list[str]":
+        """Autopilot rotation: move connections assigned to the wedged
+        campaign `frm` onto `to`.  Only MOVES existing assignments —
+        it never creates one, so a connection reaped in the same tick
+        (drop() removed its slot) is skipped rather than resurrected.
+        `conns` restricts the move to live connections; None = every
+        assignment.  Returns the connections actually rotated."""
+        if to not in self._rates or frm == to:
+            return []
+        moved: list[str] = []
+        with self._mu:
+            allowed = None if conns is None else set(conns)
+            for conn, camp in list(self._assigned.items()):
+                if camp != frm:
+                    continue
+                if allowed is not None and conn not in allowed:
+                    continue
+                self._assigned[conn] = to
+                moved.append(conn)
+            if moved:
+                self._rates[to].exec_total = min(
+                    self._rates[to].exec_total, self.min_execs // 2)
+                self.stat_rotations += len(moved)
+        if moved:
+            if self._c_rotations is not None:
+                self._c_rotations.inc(len(moved))
+            log.logf(0, "campaign rotation (autopilot): %s -> %s for %s",
+                     frm, to, ",".join(moved))
+        return moved
 
     # -- snapshot/restore (resilience plane) -------------------------------
 
@@ -205,6 +363,11 @@ class CampaignScheduler:
                 "rates": rates,
                 "tags": {c: list(v) for c, v in self._tags.items()},
                 "rotations": self.stat_rotations,
+                "clusters": {c: sorted(v)
+                             for c, v in self._cluster_ids.items()},
+                "cluster_rates": {
+                    c: r.rate(now)
+                    for c, r in self._cluster_rates.items()},
             }
 
     def import_state(self, state: dict) -> None:
@@ -228,6 +391,13 @@ class CampaignScheduler:
                 if c in self._tags:
                     merged = dict.fromkeys(list(self._tags[c]) + list(sigs))
                     self._tags[c] = list(merged)
+            for c, ids in (state.get("clusters") or {}).items():
+                if c in self._cluster_ids:
+                    self._cluster_ids[c].update(ids)
+            for c, rate in (state.get("cluster_rates") or {}).items():
+                r = self._cluster_rates.get(c)
+                if r is not None:
+                    r.seed(float(rate), now=now)
             self.stat_rotations = max(self.stat_rotations,
                                       int(state.get("rotations", 0)))
 

@@ -82,6 +82,18 @@ class Fuzzer:
         self._c_rpc_failures = self.registry.counter(
             "syz_rpc_failures_total",
             "RPC calls abandoned after exhausting retries")
+        # manager overload backpressure: a NewInput answered with the
+        # "shed" reply keeps the input local-only and opens a doubling
+        # backoff window during which triage skips the delivery RPC
+        # entirely (the manager asked us to stop hammering it)
+        self._c_shed_replies = self.registry.counter(
+            "syz_fuzzer_admission_shed_total",
+            "NewInputs the manager shed under overload")
+        self._c_local_only = self.registry.counter(
+            "syz_fuzzer_local_only_total",
+            "triaged inputs kept local-only during a shed backoff window")
+        self._shed_until = 0.0
+        self._shed_backoff = 1.0
         self.client = rpc.RpcClient(manager_addr,
                                     retry_counter=self._c_rpc_retries)
         self._ts_shipped = None          # poll-delta watermark for the
@@ -460,8 +472,13 @@ class Fuzzer:
                                          corpus_index=len(self.corpus) - 1)
         self._stat_counters["new inputs"].inc()
         span.add_hop("fuzzer:triage+minimize", time.monotonic() - t_triage)
+        if self._shed_active():
+            # overloaded manager asked for backpressure: local-only
+            # triage — the input is already in the local corpus, and
+            # skipping the RPC is exactly the relief it needs
+            return
         try:
-            self.client.call("Manager.NewInput", {
+            r = self.client.call("Manager.NewInput", {
                 "name": self.name,
                 "call": item.prog.calls[item.call_index].meta.name,
                 "prog": rpc.b64(data),
@@ -474,6 +491,30 @@ class Fuzzer:
             # the local corpus and fuzzing continues
             self._c_rpc_failures.inc()
             log.logf(0, "NewInput delivery failed after retries: %s", e)
+            return
+        self._note_delivery_reply(r)
+
+    def _shed_active(self) -> bool:
+        """True while inside a shed backoff window (delivery skipped,
+        counted local-only)."""
+        if time.monotonic() < self._shed_until:
+            self._c_local_only.inc()
+            return True
+        return False
+
+    def _note_delivery_reply(self, r) -> None:
+        """Fold one NewInput reply into the backpressure state: a
+        "shed" reply opens a doubling local-only backoff window (the
+        manager is overloaded — re-sending into the storm is the one
+        thing that cannot help); a clean ack resets the backoff."""
+        if isinstance(r, dict) and r.get("shed"):
+            self._c_shed_replies.inc()
+            self._shed_until = time.monotonic() + self._shed_backoff
+            self._shed_backoff = min(self._shed_backoff * 2.0, 30.0)
+            log.logf(1, "manager shed NewInput; local-only triage for "
+                     "%.1fs", self._shed_until - time.monotonic())
+        else:
+            self._shed_backoff = 1.0
 
     def minimize_input(self, env: ipc.Env, item: TriageItem,
                        stable_new: np.ndarray, pid: int

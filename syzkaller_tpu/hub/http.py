@@ -67,6 +67,18 @@ def serve(hub, host: str, port: int) -> ThreadingHTTPServer:
                     self._send(expo.prometheus_text([hub.registry]),
                                ctype="text/plain; version=0.0.4; "
                                      "charset=utf-8")
+                elif self.path.split("?")[0] == "/healthz":
+                    # hub liveness for the same orchestrator probe
+                    # contract as the manager's /healthz: 200 while
+                    # the state plane answers, with the federation
+                    # summary as the body
+                    import json
+                    st = hub.state
+                    self._send(json.dumps({
+                        "status": "ok",
+                        "corpus": len(st.seq),
+                        "managers": len(st.managers),
+                    }), ctype="application/json")
                 elif self.path.startswith("/log"):
                     self._send("<pre>%s</pre>" %
                                html_mod.escape(log.cached_log()))

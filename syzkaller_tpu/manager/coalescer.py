@@ -20,6 +20,17 @@ The wire protocol and admission semantics are byte-identical to the
 serial path: callers see the same empty reply, duplicates and
 no-new-signal inputs count as "rejected inputs", admitted inputs
 broadcast to the other fuzzers and persist to disk.
+
+Overload protection: the queue is BOUNDED (`queue_cap`) with
+deadline-based load shedding (`shed_deadline`).  When concurrent
+NewInputs outrun the drain rate, the OLDEST pending admission is shed —
+resolved immediately with `{"shed": True}` and counted in
+`syz_admission_shed_total` — instead of growing the queue toward an
+OOM or blocking callers unboundedly.  Shed callers (fuzzers) keep the
+input in their local corpus and degrade to local-only triage with
+backoff, so overload degrades throughput gracefully: fresh inputs keep
+flowing at the drain rate, p99 admit latency stays bounded by the
+deadline, and nothing blocks forever.
 """
 
 from __future__ import annotations
@@ -63,14 +74,21 @@ class AdmissionCoalescer:
     # dispatch overhead, dominates)
     MIN_B, MIN_K = 8, 32
 
+    # the reply a shed admission resolves with: the fuzzer keeps the
+    # input local-only and backs off deliveries
+    SHED_REPLY = {"shed": True}
+
     def __init__(self, manager, max_batch: int = 64,
                  choices_per_step: int = 256, choice_ring_cap: int = 4096,
-                 gather_ms: float = 1.0):
+                 gather_ms: float = 1.0, queue_cap: int = 0,
+                 shed_deadline: float = 0.0):
         self.mgr = manager
         self.max_batch = max_batch
         self.choices_per_step = choices_per_step
         self.choice_ring_cap = choice_ring_cap
         self.gather_ms = gather_ms
+        self.queue_cap = int(queue_cap)
+        self.shed_deadline = float(shed_deadline)
         self._q: deque[_Pending] = deque()
         self._cv = threading.Condition()
         self._stop = False
@@ -78,6 +96,7 @@ class AdmissionCoalescer:
         self._choice_mu = threading.Lock()
         self.stat_batches = 0
         self.stat_coalesced = 0          # inputs that shared a dispatch
+        self.stat_shed = 0               # admissions shed under overload
         self._thread = threading.Thread(target=self._drain_loop,
                                         name="admission-coalescer",
                                         daemon=True)
@@ -94,13 +113,29 @@ class AdmissionCoalescer:
                      call_index=call_index, call_id=call_id, cover=cover,
                      wire_prog=wire_prog, wire_cover=wire_cover,
                      trace=trace)
+        shed: "list[_Pending]" = []
         with self._cv:
             if self._stop:
                 return {}
+            # bounded queue: shed the OLDEST pending admissions to make
+            # room (they have waited longest and are most likely past
+            # any useful deadline) instead of growing without bound
+            while self.queue_cap > 0 and len(self._q) >= self.queue_cap:
+                shed.append(self._q.popleft())
             self._q.append(p)
             self._cv.notify()
+        self._resolve_shed(shed)
         p.done.wait()
         return p.result
+
+    def _resolve_shed(self, shed: "list[_Pending]") -> None:
+        if not shed:
+            return
+        for s in shed:
+            s.result = dict(self.SHED_REPLY)
+            s.done.set()
+        self.stat_shed += len(shed)
+        self.mgr._c_shed.inc(len(shed))
 
     def pop_choices(self, n: int) -> list[int]:
         """Up to n pre-drawn ChoiceTable decisions (may return fewer —
@@ -162,8 +197,21 @@ class AdmissionCoalescer:
                     if len(self._q) == prev_len:
                         break                      # plateaued
                     prev_len = len(self._q)
+                # deadline-based shedding: entries that waited past the
+                # deadline are stale (the drain is not keeping up —
+                # genuine overload); resolve them shed instead of
+                # spending the fused dispatch on them.  Oldest first:
+                # the queue is FIFO, so the expired prefix IS the
+                # oldest work.
+                expired: "list[_Pending]" = []
+                if self.shed_deadline > 0:
+                    now = time.monotonic()
+                    while self._q and now - self._q[0].enqueued \
+                            > self.shed_deadline:
+                        expired.append(self._q.popleft())
                 batch = [self._q.popleft()
                          for _ in range(min(len(self._q), self.max_batch))]
+            self._resolve_shed(expired)
             try:
                 self._process(batch)
             except Exception as e:  # resolve tickets even on engine bugs

@@ -79,6 +79,31 @@ class Config:
     #                                    this long: campaign assignment
     #                                    and queued inputs return to the
     #                                    pool (0 = never reap)
+    # fleet autopilot (closed-loop control plane)
+    autopilot: bool = True             # run the supervisor loop in the
+    #                                    manager run loop: health state
+    #                                    machines over /metrics + typed
+    #                                    rate-limited recovery actions
+    autopilot_interval: float = 5.0    # control-loop tick cadence (s)
+    autopilot_min_vms: int = 0         # elastic scale-down floor
+    #                                    (0 = scale-down disabled)
+    autopilot_max_vms: int = 0         # elastic scale-up ceiling
+    #                                    (0 = scale-up disabled; capacity
+    #                                    REPAIR to target is always on)
+    autopilot_actions_per_min: float = 6.0
+    #                                  # token-bucket refill per action
+    #                                    class (restart-storm limiter)
+    autopilot_burst: int = 2           # token-bucket burst capacity
+    autopilot_cooldown: float = 10.0   # min spacing between actions of
+    #                                    one class (s)
+    # admission overload protection (backpressure)
+    admit_queue_cap: int = 4096        # bounded coalescer queue: beyond
+    #                                    this, the OLDEST pending
+    #                                    admission is shed with a "shed"
+    #                                    reply (0 = unbounded)
+    admit_shed_deadline: float = 2.0   # pending admissions older than
+    #                                    this are shed at drain time
+    #                                    (0 = no deadline shedding)
     # VM-type specific (qemu)
     kernel: str = ""
     image: str = ""
@@ -187,6 +212,35 @@ class Config:
         if self.conn_timeout < 0:
             raise ConfigError(
                 f"invalid conn_timeout {self.conn_timeout}")
+        if self.autopilot_interval <= 0:
+            raise ConfigError(
+                f"invalid autopilot_interval {self.autopilot_interval}")
+        if not 0 <= self.autopilot_min_vms <= 1000:
+            raise ConfigError(
+                f"invalid autopilot_min_vms {self.autopilot_min_vms}")
+        if not 0 <= self.autopilot_max_vms <= 1000:
+            raise ConfigError(
+                f"invalid autopilot_max_vms {self.autopilot_max_vms}")
+        if 0 < self.autopilot_max_vms < self.autopilot_min_vms:
+            raise ConfigError(
+                f"autopilot_min_vms {self.autopilot_min_vms} > "
+                f"autopilot_max_vms {self.autopilot_max_vms}")
+        if self.autopilot_actions_per_min <= 0:
+            raise ConfigError(
+                "invalid autopilot_actions_per_min "
+                f"{self.autopilot_actions_per_min}")
+        if self.autopilot_burst < 1:
+            raise ConfigError(
+                f"invalid autopilot_burst {self.autopilot_burst} (>= 1)")
+        if self.autopilot_cooldown < 0:
+            raise ConfigError(
+                f"invalid autopilot_cooldown {self.autopilot_cooldown}")
+        if self.admit_queue_cap < 0:
+            raise ConfigError(
+                f"invalid admit_queue_cap {self.admit_queue_cap}")
+        if self.admit_shed_deadline < 0:
+            raise ConfigError(
+                f"invalid admit_shed_deadline {self.admit_shed_deadline}")
         # NOTE: device availability for `mesh` is checked when the
         # manager builds the engine (cover.engine.pc_mesh raises) —
         # config linting must not initialize an accelerator runtime.

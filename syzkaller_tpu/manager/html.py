@@ -49,6 +49,15 @@ def serve(mgr, host: str, port: int) -> ThreadingHTTPServer:
                     self._send(json.dumps(mgr.telemetry_snapshot(),
                                           default=str),
                                ctype="application/json")
+                elif u.path == "/healthz":
+                    # the autopilot's per-component health: 200 while
+                    # nothing is DEGRADED, 503 otherwise — the probe
+                    # contract for external orchestrators (k8s-style
+                    # probes, the gce tier) without scraping /metrics
+                    import json
+                    code, body = mgr.health_json()
+                    self._send(json.dumps(body, default=str), code,
+                               ctype="application/json")
                 elif u.path == "/corpus":
                     self._send(corpus(mgr))
                 elif u.path == "/crash":

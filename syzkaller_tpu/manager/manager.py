@@ -224,12 +224,27 @@ class Manager:
 
         # batched admission plane: concurrent NewInput RPCs coalesce
         # into fused device dispatches instead of paying one device
-        # round-trip per input (round-2 verdict weak #5)
+        # round-trip per input (round-2 verdict weak #5).  The queue is
+        # BOUNDED: past admit_queue_cap (or admit_shed_deadline of
+        # waiting) the oldest pending admission is shed with a "shed"
+        # reply instead of growing the queue toward an OOM — fuzzers
+        # degrade to local-only triage and back off.
         self.coalescer = None
         if cfg.admit_batch > 1:
-            from syzkaller_tpu.manager.coalescer import AdmissionCoalescer
-            self.coalescer = AdmissionCoalescer(
-                self, max_batch=cfg.admit_batch)
+            self.coalescer = self._make_coalescer()
+
+        # VM fleet capacity: a resizable thread-per-instance pool (the
+        # autopilot's scale/repair seam); start() sizes it to cfg.count
+        self.vm_pool = vm.VmPool(self._vm_runner)
+
+        # fleet autopilot: the closed control loop over the telemetry
+        # plane — health state machines per component, typed
+        # rate-limited actions through the recovery seams, circuit
+        # breaker to observe-only.  Ticks ride the run loop.
+        self.autopilot = None
+        if cfg.autopilot:
+            from syzkaller_tpu.autopilot import Autopilot
+            self.autopilot = Autopilot.for_manager(self, cfg)
 
         self.server = rpc.RpcServer(*self._split_addr(cfg.rpc))
         self.server.register("Manager.Connect", self.rpc_connect)
@@ -241,12 +256,23 @@ class Manager:
             self.server.observer = self._rpc_observer
         self.rpc_port = self.server.addr[1]
         self.http_server = None
-        self.vm_threads: list[threading.Thread] = []
 
     @staticmethod
     def _split_addr(addr: str) -> tuple[str, int]:
         host, _, port = addr.rpartition(":")
         return host or "127.0.0.1", int(port or 0)
+
+    def _make_coalescer(self):
+        from syzkaller_tpu.manager.coalescer import AdmissionCoalescer
+        return AdmissionCoalescer(
+            self, max_batch=self.cfg.admit_batch,
+            queue_cap=self.cfg.admit_queue_cap,
+            shed_deadline=self.cfg.admit_shed_deadline)
+
+    @property
+    def vm_threads(self) -> "list[threading.Thread]":
+        """Back-compat view over the pool's threads."""
+        return self.vm_pool.threads()
 
     # -- resilience plane --------------------------------------------------
 
@@ -358,11 +384,81 @@ class Manager:
             except Exception as e:
                 log.logf(1, "frontier view %s restore failed: %s", tag, e)
         self._snapshot_triage = st
+        # resume the snapshot cadence from the RESTORED snapshot's
+        # timestamp, not from process start: restarting from zero made
+        # the cadence drift by one restart each crash (and left a
+        # just-restored manager un-snapshotted for a full interval even
+        # when the restored state was already nearly interval-old)
+        self.checkpointer.seed_cadence(st.meta.get("created_at"))
         self._f_restore.labels(outcome="snapshot").inc()
         log.logf(0, "restored snapshot %s: corpus %d, tail %d candidates"
                  "%s", os.path.basename(st.path), len(self.corpus),
                  len(self.candidates),
                  f", {missing} missing from disk" if missing else "")
+
+    # -- autopilot action seams --------------------------------------------
+
+    def scale_vms(self, target: int) -> int:
+        """Capacity seam: resize/repair the VM pool.  `resize` also
+        respawns dead vm-loop threads below the target, so the same
+        call serves elastic scaling AND lost-capacity repair.  Returns
+        the applied target (clamped to the config's own VM bound)."""
+        target = max(0, min(1000, int(target)))
+        r = self.vm_pool.resize(target)
+        if r["spawned"] or r["retired"]:
+            log.logf(0, "vm pool -> %d (spawned %s, retired %s)",
+                     target, r["spawned"], r["retired"])
+        return target
+
+    def rotate_campaign(self, frm: str, to: str) -> "list[str]":
+        """Rotation seam: move every LIVE connection assigned to the
+        wedged campaign `frm` toward `to`.  The new assignment rides
+        each connection's next Poll response (the fuzzer swaps overlays
+        through the decision-stream epoch path).  Connections reaped in
+        the same tick are skipped — their assignment already returned
+        to the scheduler pool, exactly once."""
+        with self._mu:
+            live = list(self.fuzzers)
+        return self.campaign_sched.rotate_toward(frm, to, conns=live)
+
+    def restart_component(self, name: str) -> None:
+        """Restart seam: checkpoint first (the autopilot never restarts
+        what it hasn't snapshotted), then crash-only-restart one wedged
+        in-process component by swapping a fresh instance in BEFORE
+        stopping the old one — consumers never observe a stopped
+        component."""
+        self.checkpointer.snapshot_now()
+        if name == "dstream":
+            from syzkaller_tpu.fuzzer.device_ct import DecisionStream
+            old = self.dstream
+            self.dstream = DecisionStream(self.engine, per_row=64,
+                                          telemetry=self.device_stats,
+                                          warm_after=3)
+            if not old.stop():
+                self._f_thread_leaks.labels(thread="decision-stream").inc()
+        elif name == "coalescer":
+            old = self.coalescer
+            if self.cfg.admit_batch > 1:
+                self.coalescer = self._make_coalescer()
+            if old is not None and not old.stop():
+                self._f_thread_leaks.labels(thread="coalescer").inc()
+        else:
+            raise ValueError(f"unknown restartable component {name!r}")
+        log.logf(0, "component %s restarted (snapshot taken first)", name)
+
+    def health_json(self) -> "tuple[int, dict]":
+        """/healthz body: the autopilot's per-component health report
+        (non-200 while anything is DEGRADED) when the control loop
+        runs; a minimal backend liveness report otherwise."""
+        if self.autopilot is not None:
+            return self.autopilot.health_json()
+        degraded = bool(getattr(self.engine, "degraded", False))
+        return (503 if degraded else 200), {
+            "status": "degraded" if degraded else "ok",
+            "autopilot": "off",
+            "components": {"backend": {
+                "state": "DEGRADED" if degraded else "HEALTHY"}},
+        }
 
     def _touch(self, name: str) -> None:
         """Heartbeat: every RPC from a fuzzer refreshes its liveness
@@ -521,6 +617,20 @@ class Manager:
             "syz_thread_leak_total",
             "shutdown joins that abandoned a wedged thread",
             labels=("thread",))
+        # overload protection + autopilot capacity series
+        self._c_shed = r.counter(
+            "syz_admission_shed_total",
+            "pending admissions shed under overload (bounded queue + "
+            "deadline); callers got the 'shed' reply and degraded to "
+            "local-only triage")
+        r.gauge("syz_admission_queue_depth",
+                "admissions waiting in the coalescer queue",
+                fn=lambda: (float(len(self.coalescer._q))
+                            if self.coalescer is not None else 0.0))
+        r.gauge("syz_vm_pool_target", "intended VM pool size",
+                fn=lambda: float(self.vm_pool.target))
+        r.gauge("syz_vm_pool_live", "vm-loop threads alive",
+                fn=lambda: float(self.vm_pool.live))
 
     def _rpc_observer(self, method: str, seconds: float,
                       params: dict) -> None:
@@ -990,7 +1100,7 @@ class Manager:
             pass
         return links
 
-    def save_crash(self, outcome) -> str:
+    def save_crash(self, outcome, vm_name: str = "") -> str:
         """Crash persistence keyed by CLUSTER: the signature kernel
         assigns the report to a cluster (title n-grams + stack frames,
         device-batched similarity), replacing title-string-equality
@@ -1005,6 +1115,10 @@ class Manager:
         t0 = time.monotonic()
         cid = self.crash_index.assign([(title, frames)])[0]
         self._c_triage_assigned.inc()
+        # cluster-aware rotation signal: the crashing VM's campaign
+        # gets the cluster attributed — campaigns whose clusters keep
+        # GROWING are what the autopilot rotates toward
+        self.campaign_sched.note_cluster(vm_name, cid)
         d = os.path.join(self.crashdir, cid)
         os.makedirs(d, exist_ok=True)
         desc = os.path.join(d, "description")
@@ -1157,8 +1271,15 @@ class Manager:
         return " ".join(shlex.quote(x) for x in a)
 
     def vm_loop(self, index: int) -> None:
+        """Back-compat entry: one VM loop with no retire signal."""
+        self._vm_runner(index, threading.Event())
+
+    def _vm_runner(self, index: int, retire: threading.Event) -> None:
+        """The VmPool runner: create-run-monitor-reboot until manager
+        stop or pool retirement.  Retirement takes effect at the next
+        reboot boundary (a VM run in flight finishes its cycle)."""
         suppressions = self.cfg.compiled_suppressions()
-        while not self._stop:
+        while not self._stop and not retire.is_set():
             inst = None
             try:
                 inst = vm.create(self.cfg.type, self.cfg, index)
@@ -1173,7 +1294,8 @@ class Manager:
                 handle.stop()
                 # shutdown kills the fuzzer: its EOF is not a crash
                 if outcome.crashed and not self._stop:
-                    crash_dir = self.save_crash(outcome)
+                    crash_dir = self.save_crash(outcome,
+                                                vm_name=f"vm{index}")
                     self.maybe_schedule_repro(outcome, crash_dir)
             except Exception as e:
                 log.logf(0, "vm-%d error: %s", index, e)
@@ -1196,10 +1318,7 @@ class Manager:
         if self.cfg.http:
             from syzkaller_tpu.manager import html
             self.http_server = html.serve(self, *self._split_addr(self.cfg.http))
-        for i in range(self.cfg.count):
-            t = threading.Thread(target=self.vm_loop, args=(i,), daemon=True)
-            t.start()
-            self.vm_threads.append(t)
+        self.vm_pool.resize(self.cfg.count)
         if self.cfg.hub_addr:
             threading.Thread(target=self.hub_sync_loop, daemon=True).start()
         log.logf(0, "manager up: rpc :%d, %d %s VM(s), %d corpus candidates",
@@ -1245,15 +1364,21 @@ class Manager:
                 if time.time() - last_minimize > 300.0:
                     last_minimize = time.time()
                     self.minimize_corpus()
-                # resilience cadences: crash-only snapshots, dead-conn
-                # reaping, and the degraded-backend recovery probe
+                # resilience cadences: crash-only snapshots and
+                # dead-conn reaping stay on their own clocks
                 self.checkpointer.maybe_snapshot()
                 if time.time() - last_reap > 5.0:
                     last_reap = time.time()
                     self.reap_dead_conns()
-                probe = getattr(self.engine, "maybe_probe", None)
-                if probe is not None:
-                    probe()
+                if self.autopilot is not None:
+                    # the control loop owns recovery: backend probing
+                    # rides its PROMOTE action (rate-limited) instead
+                    # of the bare probe cadence below
+                    self.autopilot.maybe_tick()
+                else:
+                    probe = getattr(self.engine, "maybe_probe", None)
+                    if probe is not None:
+                        probe()
         finally:
             self.stop()
 
@@ -1290,15 +1415,10 @@ class Manager:
         self.server.close()
         if self.http_server is not None:
             self.http_server.shutdown()
-        leaked = 0
-        for t in self.vm_threads:
-            # a wedged VM thread must not hang shutdown forever — but
-            # silently abandoning it hid real bugs; count + log instead
-            t.join(timeout=10.0)
-            if t.is_alive():
-                leaked += 1
-                self._f_thread_leaks.labels(thread="vm-loop").inc()
+        # a wedged VM thread must not hang shutdown forever — but
+        # silently abandoning it hid real bugs; count + log instead
+        leaked = self.vm_pool.stop_all(timeout=10.0)
         if leaked:
+            self._f_thread_leaks.labels(thread="vm-loop").inc(leaked)
             log.logf(0, "shutdown leaked %d wedged vm-loop thread(s)",
                      leaked)
-        self.vm_threads = []

@@ -123,9 +123,12 @@ print("telemetry ok: %d series" % len(series))
             raise SystemExit("telemetry smoke failed")
 
     def chaos_smoke():
-        # one SIGKILL/restore cycle against a real manager subprocess:
-        # mid-admission-storm kill, snapshot restore + tail replay,
-        # frontier proven bit-exact vs a never-crashed serial run
+        # one SIGKILL/restore cycle against a real manager subprocess
+        # (mid-admission-storm kill, snapshot restore + tail replay,
+        # frontier bit-exact vs a never-crashed serial run) PLUS the
+        # autopilot compound-failure cycle (2 VM threads killed +
+        # backend flap + wedged campaign, remediated with zero
+        # operator input)
         import json
 
         r = subprocess.run(
@@ -137,8 +140,14 @@ print("telemetry ok: %d series" % len(series))
             raise SystemExit(f"chaos smoke failed ({r.returncode})")
         out = json.loads(r.stdout.strip().splitlines()[-1])
         assert out["frontier_bit_exact"] and out["corpus_lost"] == 0, out
+        auto = out["autopilot"]
+        assert auto["recovered"] and auto["frontier_bit_exact"] \
+            and auto["corpus_lost"] == 0 \
+            and auto["post_promotion_recompiles"] == 0, auto
         print(f"[presubmit]   recovery {out['recovery_seconds']}s, "
-              f"corpus {out['corpus_size']}, 0 lost")
+              f"corpus {out['corpus_size']}, 0 lost; autopilot "
+              f"detect {auto['autopilot_detect_seconds']}s / recover "
+              f"{auto['autopilot_recover_seconds']}s")
 
     def bench_smoke():
         # seconds-scale CPU-only bench pass on tiny shapes: catches

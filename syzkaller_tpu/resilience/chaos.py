@@ -442,10 +442,191 @@ def run_kill_restore_cycle(base_dir: str, n_inputs: int = 48,
             sigkill(proc)
 
 
-def _admit_direct(mgr, inp) -> dict:
+def _admit_direct(mgr, inp, name: str = "serial") -> dict:
     data, call, ci, cover = inp
     from syzkaller_tpu import rpc as rpc_mod
 
     return mgr.rpc_new_input({
-        "name": "serial", "call": call, "prog": rpc_mod.b64(data),
+        "name": name, "call": call, "prog": rpc_mod.b64(data),
         "call_index": ci, "cover": cover})
+
+
+# -- the autopilot compound-failure cycle -------------------------------------
+
+
+def run_autopilot_cycle(base_dir: str, n_inputs: int = 32, vms: int = 4,
+                        deadline_s: float = 60.0,
+                        verbose: bool = False) -> dict:
+    """Scripted compound failure remediated by the AUTOPILOT with zero
+    operator input:
+
+      admission storm → kill 2 of N VM-loop threads + flap the device
+      backend + one wedged campaign (flat frontier, execs flowing) →
+      the control loop detects all three, restores pool capacity
+      (SCALE_UP repair), promotes the backend (PROMOTE probe), and
+      rotates the wedged campaign's connection toward the campaign
+      whose crash clusters are growing (ROTATE) — within a bounded
+      recovery budget, with zero corpus loss (bit-exact frontier vs a
+      serial replay) and zero warm recompiles across the promotion
+      (CompileCounter-pinned).
+
+    The VM fleet is a stub thread pool (the pool seam is what the
+    autopilot acts on; real instances would only add minutes of boot
+    time around the same control path), the campaigns are registered
+    synthetically at the scheduler (rotation acts on scheduler state;
+    loading real campaign descriptions needs the full syscall table),
+    and ticks are driven by the harness at the configured cadence
+    (production ticks ride the manager run loop).  Returns the
+    measurements dict (autopilot_detect_seconds,
+    autopilot_recover_seconds, actions fired, verification bits)."""
+    from syzkaller_tpu.manager.config import Config
+    from syzkaller_tpu.manager.manager import FuzzerConn, Manager
+    from syzkaller_tpu.sys.table import load_table
+    from syzkaller_tpu.vet.runtime import CompileCounter
+
+    def say(msg):
+        if verbose:
+            sys.stderr.write(f"[chaos:autopilot] {msg}\n")
+            sys.stderr.flush()
+
+    table = load_table(files=["probe.txt"])
+    inputs = synth_inputs(table, n_inputs + 4, seed=13)
+    warm, inputs, post = inputs[:2], inputs[2:-2], inputs[-2:]
+    half = len(inputs) // 2
+    w = os.path.join(base_dir, "w-autopilot")
+    cfg = Config(**manager_config(
+        w, 0, snapshot_interval=0.0, conn_timeout=0.0,
+        autopilot_interval=0.05, autopilot_cooldown=0.05,
+        autopilot_actions_per_min=600.0, autopilot_burst=4))
+    mgr = Manager(cfg, table=table)
+    out: dict = {}
+    try:
+        ap = mgr.autopilot
+        assert ap is not None
+
+        # stub VM fleet: runner threads that idle until retired or
+        # killed; killing one is the thread-level analog of SIGKILLing
+        # its fuzzer VM
+        kills = {i: threading.Event() for i in range(vms)}
+
+        def stub_runner(index, retire):
+            k = kills.setdefault(index, threading.Event())
+            while not retire.is_set() and not k.is_set():
+                time.sleep(0.005)
+
+        mgr.vm_pool._runner = stub_runner
+        mgr.scale_vms(vms)
+
+        # synthetic campaigns at the scheduler seam: one wedged (execs
+        # flowing, frontier flat, no cluster growth), one hot (growing
+        # crash clusters — the rotation target)
+        sched = mgr.campaign_sched
+        sched.register_campaign("camp-wedged")
+        sched.register_campaign("camp-hot")
+        sched.force_assign("vmA", "camp-wedged")
+        sched.force_assign("vmB", "camp-hot")
+        with mgr._mu:
+            mgr.fuzzers["vmA"] = FuzzerConn(name="vmA")
+            mgr.fuzzers["vmB"] = FuzzerConn(name="vmB")
+        for i in range(6):
+            sched.note_execs("vmA", 2000)
+            sched.note_execs("vmB", 2000)
+            sched.note_new_cov("vmB", 50, sig_hex=f"b{i:039d}")
+            sched.note_cluster("vmB", f"cluster-{i}")
+            mgr._e_exec_rate.add(2000)
+            time.sleep(0.01)
+
+        say("warming dispatch shapes + baseline ticks")
+        for inp in warm:
+            _admit_direct(mgr, inp, name="chaosA")
+        mgr.engine.primary.random_words(64)      # the probe's dispatch
+        for _ in range(3):
+            ap.tick()
+            time.sleep(0.02)
+        for inp in inputs[:half]:
+            _admit_direct(mgr, inp, name="chaosA")
+
+        say("compound failure: kill 2 VM threads + arm backend fault")
+        t_fault = time.monotonic()
+        for i in (0, 1):
+            kills[i].set()
+        while mgr.vm_pool.live > vms - 2:
+            time.sleep(0.005)
+        for i in (0, 1):                 # one-shot kill: repair survives
+            kills[i].clear()
+        mgr.engine.injector.arm(1)
+        # the storm continues through the fault: the supervisor fails
+        # over mid-batch, nothing is lost
+        for inp in inputs[half:]:
+            _admit_direct(mgr, inp, name="chaosA")
+        assert mgr.engine.degraded, "fault did not quarantine the backend"
+
+        say("autopilot remediation loop")
+        t_detect = None
+        t_recovered = None
+        deadline = time.monotonic() + deadline_s
+        while time.monotonic() < deadline:
+            report = ap.tick()
+            if t_detect is None and any(
+                    a["outcome"] == "fired" for a in report["actions"]):
+                t_detect = time.monotonic()
+            pool_ok = mgr.vm_pool.live >= vms
+            backend_ok = not mgr.engine.degraded
+            rotated = sched.current("vmA") == "camp-hot"
+            if pool_ok and backend_ok and rotated:
+                t_recovered = time.monotonic()
+                break
+            time.sleep(0.02)
+        if t_recovered is None:
+            raise AssertionError(
+                f"autopilot did not remediate in {deadline_s}s: "
+                f"pool {mgr.vm_pool.live}/{vms}, "
+                f"degraded={mgr.engine.degraded}, "
+                f"vmA={sched.current('vmA')}, "
+                f"health={ap.health.snapshot()}")
+        out["autopilot_detect_seconds"] = round(t_detect - t_fault, 3)
+        out["autopilot_recover_seconds"] = round(t_recovered - t_fault, 3)
+        out["actions"] = ap.log.snapshot(32)
+        out["breaker_trips"] = ap.breaker.trips
+
+        # zero warm recompiles across the promotion: the device engine
+        # was warmed pre-fault, so post-promotion admissions (same
+        # pow2-bucketed shapes) move arrays only
+        with CompileCounter() as cc:
+            for inp in post:
+                _admit_direct(mgr, inp, name="chaosA")
+        out["post_promotion_recompiles"] = cc.count
+
+        # zero corpus loss: every acked input present, frontier
+        # bit-exact vs a never-crashed serial replay sharing the
+        # sparse→dense PC mapping
+        all_inputs = warm + inputs + post
+        wserial = os.path.join(base_dir, "w-autopilot-serial")
+        cfgS = Config(**manager_config(wserial, 0, snapshot_interval=0.0,
+                                       autopilot=False))
+        mgrS = Manager(cfgS, table=table)
+        mgrS.pcmap.preseed(mgr.pcmap.export_keys())
+        for inp in all_inputs:
+            _admit_direct(mgrS, inp)
+        covA = np.asarray(mgr.engine.corpus_cover)
+        covS = np.asarray(mgrS.engine.corpus_cover)
+        out["frontier_bit_exact"] = bool((covA == covS).all())
+        sigsA = {hashlib.sha1(it.data).hexdigest()
+                 for it in mgr.corpus.values()}
+        sigsS = {hashlib.sha1(it.data).hexdigest()
+                 for it in mgrS.corpus.values()}
+        out["corpus_lost"] = len(sigsS - sigsA)
+        out["corpus_size"] = len(mgr.corpus)
+        mgrS.stop()
+        out["recovered"] = True
+        if not out["frontier_bit_exact"] or out["corpus_lost"]:
+            raise AssertionError(f"corpus diverged: {out}")
+        if out["post_promotion_recompiles"]:
+            raise AssertionError(
+                f"{out['post_promotion_recompiles']} warm recompiles "
+                "after promotion")
+        say(f"ok: {out['autopilot_detect_seconds']}s detect, "
+            f"{out['autopilot_recover_seconds']}s recover")
+        return out
+    finally:
+        mgr.stop()

@@ -259,12 +259,30 @@ class Checkpointer:
                 "seconds since the last successful snapshot",
                 fn=lambda: time.monotonic() - self._last)
 
+    def seed_cadence(self, created_at_wall: "float | None") -> None:
+        """Resume the periodic cadence from a RESTORED snapshot's wall
+        timestamp: without this, every restart reset the timer to zero,
+        so the cadence drifted by one restart per crash and freshly-
+        restored (but already interval-old) state sat un-snapshotted
+        for a whole extra interval."""
+        if created_at_wall is None:
+            return
+        age = max(0.0, time.time() - float(created_at_wall))
+        self._last = time.monotonic() - age
+
     def maybe_snapshot(self, now: "float | None" = None) -> "str | None":
         if self.interval <= 0:
             return None
         now = time.monotonic() if now is None else now
         if now - self._last < self.interval:
             return None
+        return self.snapshot_once()
+
+    def snapshot_now(self) -> "str | None":
+        """On-demand snapshot — the autopilot checkpoints before any
+        controlled restart.  Works even when the periodic cadence is
+        disabled, and a success resets that cadence (the state on disk
+        is fresh either way)."""
         return self.snapshot_once()
 
     def snapshot_once(self) -> "str | None":

@@ -1,7 +1,7 @@
 """Machine layer: Instance plugin registry + adapters + monitor."""
 
 from syzkaller_tpu.vm.base import (  # noqa: F401
-    Instance, OutputMerger, RunHandle, create, register, types,
+    Instance, OutputMerger, RunHandle, VmPool, create, register, types,
 )
 from syzkaller_tpu.vm.monitor import Outcome, monitor_execution  # noqa: F401
 from syzkaller_tpu.vm import local  # noqa: F401  (registers "local")

@@ -66,6 +66,96 @@ class Instance(ABC):
         ...
 
 
+class VmPool:
+    """Resizable thread-per-instance VM pool — the autopilot's capacity
+    seam.
+
+    `runner(index, retire)` is the per-instance loop (the manager's VM
+    loop: create instance, run fuzzer, monitor, reboot) and must return
+    promptly once `retire` (a threading.Event) is set.  `resize(n)`
+    moves the pool toward n instances: indices >= n are retired, and any
+    index < n whose thread is missing OR dead is (re)spawned — so
+    `resize(target)` doubles as the REPAIR operation that restores
+    capacity after VM-loop threads die (the autopilot calls it when
+    `live` falls below `target`)."""
+
+    def __init__(self, runner: Callable, name: str = "vm-loop"):
+        self._runner = runner
+        self._name = name
+        self._mu = threading.Lock()
+        # index -> (thread, retire event); retired slots are dropped
+        self._slots: dict[int, tuple[threading.Thread, threading.Event]] = {}
+        self._target = 0
+
+    @property
+    def target(self) -> int:
+        with self._mu:
+            return self._target
+
+    @property
+    def live(self) -> int:
+        """Threads currently alive and not retiring."""
+        with self._mu:
+            return sum(1 for t, ev in self._slots.values()
+                       if t.is_alive() and not ev.is_set())
+
+    def indices(self) -> "list[int]":
+        with self._mu:
+            return sorted(i for i, (t, ev) in self._slots.items()
+                          if t.is_alive() and not ev.is_set())
+
+    def resize(self, target: int) -> "dict[str, list[int]]":
+        """Grow/shrink/repair to `target` instances; returns the
+        {"spawned": [...], "retired": [...]} delta."""
+        target = max(0, int(target))
+        spawned: list[int] = []
+        retired: list[int] = []
+        with self._mu:
+            self._target = target
+            for i in sorted(self._slots):
+                if i >= target:
+                    t, ev = self._slots.pop(i)
+                    ev.set()
+                    retired.append(i)
+            for i in range(target):
+                cur = self._slots.get(i)
+                if cur is not None and cur[0].is_alive() \
+                        and not cur[1].is_set():
+                    continue
+                ev = threading.Event()
+                t = threading.Thread(target=self._runner, args=(i, ev),
+                                     name=f"{self._name}-{i}", daemon=True)
+                self._slots[i] = (t, ev)
+                t.start()
+                spawned.append(i)
+        return {"spawned": spawned, "retired": retired}
+
+    def repair(self) -> "list[int]":
+        """Respawn dead threads below the current target."""
+        with self._mu:
+            target = self._target
+        return self.resize(target)["spawned"]
+
+    def threads(self) -> "list[threading.Thread]":
+        with self._mu:
+            return [t for t, _ev in self._slots.values()]
+
+    def stop_all(self, timeout: float = 10.0) -> int:
+        """Retire every slot and join; returns how many threads failed
+        to stop in time (leaked — the caller counts them)."""
+        with self._mu:
+            slots, self._slots = list(self._slots.values()), {}
+            self._target = 0
+        for _t, ev in slots:
+            ev.set()
+        leaked = 0
+        for t, _ev in slots:
+            t.join(timeout=timeout)
+            if t.is_alive():
+                leaked += 1
+        return leaked
+
+
 class OutputMerger:
     """Multiplex several byte streams into one queue, tee'd to an
     optional file (ref vm/merger.go:13-76)."""

@@ -1,0 +1,681 @@
+"""Fleet-autopilot tests: the health state machine's hysteresis, the
+action safety layer (token buckets, cooldowns, the circuit breaker's
+observe-only trip), policy decisions (scale against frontier growth vs
+choice-stream underruns, cluster-aware rotation, snapshot-then-restart),
+the manager action seams (/healthz, VM pool resize, component restart),
+admission overload shedding, the reap × rotation exactly-once
+interaction, and the compound-failure chaos acceptance cycle."""
+
+import json
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from syzkaller_tpu.autopilot import (
+    Autopilot, CircuitBreaker, HealthMachine, Policy, PolicyConfig,
+    RateLimiter, ReportExecutor, SampleView, State, series_key)
+from syzkaller_tpu.autopilot.actions import (
+    FIRED, OBSERVE_ONLY, PROMOTE, RATE_LIMITED, RESTART, ROTATE,
+    SCALE_DOWN, SCALE_UP, Action)
+from syzkaller_tpu.autopilot.health import FleetHealth
+from syzkaller_tpu.campaign import CampaignScheduler
+from syzkaller_tpu.manager.config import Config, ConfigError
+from syzkaller_tpu.manager.manager import FuzzerConn, Manager
+from syzkaller_tpu.resilience import chaos
+from syzkaller_tpu.sys.table import load_table
+
+
+@pytest.fixture(scope="module")
+def table():
+    return load_table(files=["probe.txt"])
+
+
+def make_mgr(workdir, table, **over):
+    cfg = dict(chaos.manager_config(str(workdir), 0),
+               snapshot_interval=0.0)
+    cfg.update(over)
+    return Manager(Config(**cfg), table=table)
+
+
+# -- health state machine ----------------------------------------------------
+
+
+def test_health_machine_hysteresis_both_edges():
+    now = [0.0]
+    m = HealthMachine("x", suspect_after=2, degrade_after=2,
+                      recover_after=3, now=lambda: now[0])
+    assert m.state is State.HEALTHY
+    # one bad sample is noise, not a transition
+    assert m.observe(False) is State.HEALTHY
+    assert m.observe(True) is State.HEALTHY
+    # streaks drive the up edge: 2 bad → SUSPECT, 2 more → DEGRADED
+    m.observe(False)
+    assert m.observe(False, "it broke") is State.SUSPECT
+    m.observe(False)
+    assert m.observe(False) is State.DEGRADED
+    assert m.reason == "it broke"
+    # the down edge has hysteresis too: DEGRADED steps through SUSPECT
+    m.observe(True)
+    m.observe(True)
+    assert m.observe(True) is State.SUSPECT
+    m.observe(True)
+    m.observe(True)
+    assert m.observe(True) is State.HEALTHY
+    assert m.reason == ""
+
+
+def test_health_machine_restarting_grace():
+    m = HealthMachine("x", suspect_after=1, degrade_after=1,
+                      recover_after=2, restart_grace=3)
+    for _ in range(2):
+        m.observe(False)
+    assert m.state is State.DEGRADED
+    m.mark_restarting()
+    assert m.state is State.RESTARTING
+    # bad observations within the grace window do NOT re-degrade (a
+    # component mid-restart legitimately looks dead)
+    for _ in range(3):
+        assert m.observe(False) is State.RESTARTING
+    # recovery from RESTARTING goes straight to HEALTHY
+    m.observe(True)
+    assert m.observe(True) is State.HEALTHY
+    # ...but past the grace it falls back to DEGRADED
+    m.mark_restarting()
+    for _ in range(4):
+        m.observe(False)
+    assert m.state is State.DEGRADED
+
+
+def test_fleet_health_score_and_worst():
+    fh = FleetHealth()
+    fh.observe("a", True)
+    fh.observe("b", True)
+    assert fh.score() == 0.0 and fh.worst() is State.HEALTHY
+    for _ in range(4):
+        fh.observe("b", False)
+    assert fh.worst() is State.DEGRADED
+    assert fh.score() == pytest.approx(1.0)      # (0 + 2) / 2
+
+
+# -- rate limiting + circuit breaker -----------------------------------------
+
+
+def test_rate_limiter_bucket_and_cooldown():
+    now = [0.0]
+    lim = RateLimiter(actions_per_min=60.0, burst=2, cooldown=3.0,
+                      now=lambda: now[0])
+    assert lim.admit(RESTART) is None            # burst token 1
+    now[0] += 3.1                                # past cooldown
+    assert lim.admit(RESTART) is None            # burst token 2
+    now[0] += 0.5
+    # cooldown blocks even though the bucket refilled a little
+    assert lim.admit(RESTART) == RATE_LIMITED
+    now[0] += 3.0
+    assert lim.admit(RESTART) is None            # refilled + cooled down
+    # classes are independent
+    assert lim.admit(PROMOTE) is None
+
+
+def test_rate_limiter_caps_storm():
+    """A flapping signal proposing the same action every tick is capped
+    at the token-bucket rate, not the tick rate."""
+    now = [0.0]
+    lim = RateLimiter(actions_per_min=6.0, burst=2, cooldown=0.0,
+                      now=lambda: now[0])
+    fired = 0
+    for _ in range(600):                         # 60s of 0.1s ticks
+        if lim.admit(RESTART) is None:
+            fired += 1
+        now[0] += 0.1
+    # burst (2) + refills (6/min × 1min = 6) ± one boundary token
+    assert fired <= 9, fired
+
+
+def test_breaker_trips_on_ineffective_repetition():
+    now = [0.0]
+    br = CircuitBreaker(window=8, min_fired=3, trip_for=60.0,
+                        now=lambda: now[0])
+    # a recovery that WORKS never trips: each class fires once and its
+    # component goes healthy
+    br.note_tick([(PROMOTE, "backend")], {"backend"})
+    br.note_tick([(SCALE_UP, "vm_pool")], {"vm_pool"})
+    br.note_tick([], set())
+    assert not br.observe_only and br.trips == 0
+    # the same action hammering a still-unhealthy component trips it
+    br.note_tick([(RESTART, "choices")], {"choices"})
+    br.note_tick([(RESTART, "choices")], {"choices"})
+    assert not br.observe_only
+    assert br.note_tick([(RESTART, "choices")], {"choices"}) is True
+    assert br.observe_only and br.trips == 1
+    # the trip expires
+    now[0] += 61.0
+    assert not br.observe_only
+
+
+# -- sample view + policy ----------------------------------------------------
+
+
+def _k(name, **labels):
+    return series_key(name, **labels)
+
+
+def test_sample_view_deltas_and_family():
+    prev = {"syz_choice_ring_underrun_total": 10.0,
+            _k("syz_choice_draws_total", source="ring"): 100.0}
+    cur = {"syz_choice_ring_underrun_total": 40.0,
+           _k("syz_choice_draws_total", source="ring"): 130.0,
+           _k("syz_new_cov_per_1k_exec", campaign="all"): 5.0,
+           _k("syz_new_cov_per_1k_exec", campaign="a"): 1.0}
+    v = SampleView(cur, prev)
+    assert v.delta("syz_choice_ring_underrun_total") == 30.0
+    assert v.delta("syz_choice_draws_total") == 30.0
+    assert v.value("syz_new_cov_per_1k_exec", campaign="a") == 1.0
+    assert set(v.family("syz_new_cov_per_1k_exec", "campaign")) == \
+        {"all", "a"}
+    # first sample (no prev): deltas read 0, not the absolute value
+    assert SampleView(cur).delta("syz_choice_ring_underrun_total") == 0.0
+
+
+def test_policy_scale_up_blocked_by_underruns():
+    """Never add VMs the decision stream can't feed: high frontier
+    demand + high underrun rate must NOT scale up."""
+    pol = Policy(PolicyConfig(max_vms=8))
+    fh = FleetHealth()
+    base = {
+        "syz_vm_pool_live": 4.0, "syz_vm_pool_target": 4.0,
+        "syz_exec_rate": 100.0,
+        _k("syz_new_cov_per_1k_exec", campaign="all"): 50.0,
+    }
+    prev = dict(base, syz_choice_ring_underrun_total=0.0,
+                syz_choice_topup_total=0.0)
+    hungry = dict(base, syz_choice_ring_underrun_total=500.0,
+                  syz_choice_topup_total=1000.0)
+    view = SampleView(hungry, prev)
+    for comp, ok, why in pol.evaluate(view):
+        fh.observe(comp, ok, why)
+    assert not any(a.kind == SCALE_UP
+                   for a in pol.decide(fh, view))
+    # same demand with the stream keeping up → scale up by one
+    fed = dict(base, syz_choice_ring_underrun_total=1.0,
+               syz_choice_topup_total=1000.0)
+    view = SampleView(fed, prev)
+    for comp, ok, why in pol.evaluate(view):
+        fh.observe(comp, ok, why)
+    ups = [a for a in pol.decide(fh, view) if a.kind == SCALE_UP]
+    assert len(ups) == 1 and ups[0].target == 5
+
+
+def test_policy_repair_and_scale_down():
+    pol = Policy(PolicyConfig(min_vms=2, scale_down_ticks=3))
+    fh = FleetHealth()
+    short = {"syz_vm_pool_live": 2.0, "syz_vm_pool_target": 4.0,
+             "syz_exec_rate": 10.0,
+             _k("syz_new_cov_per_1k_exec", campaign="all"): 0.0}
+    view = SampleView(short, short)
+    for _ in range(2):                   # hysteresis: 2 bad ticks
+        for comp, ok, why in pol.evaluate(view):
+            fh.observe(comp, ok, why)
+    repairs = [a for a in pol.decide(fh, view) if a.kind == SCALE_UP]
+    assert len(repairs) == 1 and repairs[0].target == 4
+    # idle fleet at full capacity shrinks only after scale_down_ticks
+    idle = {"syz_vm_pool_live": 4.0, "syz_vm_pool_target": 4.0,
+            "syz_exec_rate": 10.0,
+            _k("syz_new_cov_per_1k_exec", campaign="all"): 0.0}
+    fh2 = FleetHealth()
+    pol2 = Policy(PolicyConfig(min_vms=2, scale_down_ticks=3))
+    view = SampleView(idle, idle)
+    downs = []
+    for _ in range(4):
+        for comp, ok, why in pol2.evaluate(view):
+            fh2.observe(comp, ok, why)
+        downs = [a for a in pol2.decide(fh2, view)
+                 if a.kind == SCALE_DOWN]
+        if downs:
+            break
+    assert len(downs) == 1 and downs[0].target == 3
+
+
+def test_policy_campaign_wedge_rotates_toward_clusters():
+    """A wedged campaign (flat frontier, execs flowing, no cluster
+    growth) rotates TOWARD the campaign whose crash clusters are still
+    growing — not to the best-coverage one."""
+    pol = Policy(PolicyConfig())
+    fh = FleetHealth()
+    sample = {
+        "syz_exec_rate": 50.0,
+        _k("syz_new_cov_per_1k_exec", campaign="all"): 2.0,
+        _k("syz_new_cov_per_1k_exec", campaign="wedged"): 0.0,
+        _k("syz_new_cov_per_1k_exec", campaign="covhot"): 9.0,
+        _k("syz_new_cov_per_1k_exec", campaign="clusterhot"): 1.0,
+        _k("syz_campaign_cluster_rate", campaign="wedged"): 0.0,
+        _k("syz_campaign_cluster_rate", campaign="covhot"): 0.0,
+        _k("syz_campaign_cluster_rate", campaign="clusterhot"): 0.02,
+        _k("syz_campaign_assigned", campaign="wedged"): 1.0,
+        _k("syz_campaign_assigned", campaign="covhot"): 1.0,
+        _k("syz_campaign_assigned", campaign="clusterhot"): 1.0,
+    }
+    view = SampleView(sample, sample)
+    for _ in range(4):                   # HEALTHY → SUSPECT → DEGRADED
+        for comp, ok, why in pol.evaluate(view):
+            fh.observe(comp, ok, why)
+    assert fh.state("campaign:wedged") is State.DEGRADED
+    assert fh.state("campaign:covhot") is State.HEALTHY
+    rots = [a for a in pol.decide(fh, view) if a.kind == ROTATE]
+    assert len(rots) == 1
+    assert rots[0].component == "wedged"
+    assert rots[0].target == "clusterhot"
+    # once the wedged campaign has no connections left, no more ROTATE
+    sample2 = dict(sample)
+    sample2[_k("syz_campaign_assigned", campaign="wedged")] = 0.0
+    assert not [a for a in pol.decide(fh, SampleView(sample2, sample))
+                if a.kind == ROTATE]
+
+
+# -- controller: restart storm + breaker -------------------------------------
+
+
+class _FlappingSource:
+    """A backend that reads degraded on every sample."""
+
+    def sample(self):
+        return {"syz_backend_degraded": 1.0}
+
+
+class _CountingExecutor:
+    def __init__(self):
+        self.fired = []
+
+    def execute(self, action):
+        self.fired.append(action.kind)
+        return FIRED, "pretend"
+
+
+def test_controller_storm_capped_then_breaker_trips():
+    """The acceptance scenario for the safety layer: a persistent
+    failing health signal drives the same action every tick — the token
+    bucket caps the fire rate, and once the same action has fired
+    min_fired times at a still-unhealthy component the breaker trips
+    the controller to observe-only."""
+    now = [0.0]
+    execu = _CountingExecutor()
+    pilot = Autopilot(
+        _FlappingSource(), execu, interval=1.0,
+        limiter=RateLimiter(actions_per_min=60.0, burst=2, cooldown=0.0,
+                            now=lambda: now[0]),
+        breaker=CircuitBreaker(window=8, min_fired=3, trip_for=300.0,
+                               now=lambda: now[0]),
+        now=lambda: now[0])
+    outcomes = []
+    for _ in range(12):
+        rep = pilot.tick()
+        outcomes.extend(a["outcome"] for a in rep["actions"])
+        now[0] += 1.0
+    # promote fired at most bucket-rate times, then the breaker tripped
+    assert execu.fired.count(PROMOTE) >= 3
+    assert pilot.breaker.trips == 1
+    assert OBSERVE_ONLY in outcomes
+    assert pilot.health_json()[1]["observe_only"] is True
+    # while tripped, nothing executes
+    n_before = len(execu.fired)
+    pilot.tick()
+    assert len(execu.fired) == n_before
+
+
+def test_remote_report_executor_never_acts():
+    pilot = Autopilot(_FlappingSource(), ReportExecutor(), interval=1.0)
+    for _ in range(4):
+        rep = pilot.tick()
+    assert all(a["outcome"] == OBSERVE_ONLY for a in rep["actions"])
+
+
+# -- manager seams -----------------------------------------------------------
+
+
+def test_config_autopilot_knobs_validated():
+    Config(autopilot_interval=1.0, autopilot_min_vms=1,
+           autopilot_max_vms=4).validate()
+    with pytest.raises(ConfigError):
+        Config(autopilot_interval=0.0).validate()
+    with pytest.raises(ConfigError):
+        Config(autopilot_min_vms=8, autopilot_max_vms=2).validate()
+    with pytest.raises(ConfigError):
+        Config(autopilot_burst=0).validate()
+    with pytest.raises(ConfigError):
+        Config(admit_queue_cap=-1).validate()
+    with pytest.raises(ConfigError):
+        Config(admit_shed_deadline=-0.1).validate()
+
+
+def test_vm_pool_resize_and_repair(tmp_path, table):
+    mgr = make_mgr(tmp_path / "w", table)
+    kills = {}
+
+    def stub(index, retire):
+        k = kills.setdefault(index, threading.Event())
+        while not retire.is_set() and not k.is_set():
+            time.sleep(0.002)
+
+    mgr.vm_pool._runner = stub
+    assert mgr.scale_vms(3) == 3
+    deadline = time.monotonic() + 5.0
+    while mgr.vm_pool.live < 3 and time.monotonic() < deadline:
+        time.sleep(0.01)
+    assert mgr.vm_pool.live == 3
+    # kill one thread: live drops, repair restores the SAME index
+    kills[1].set()
+    while mgr.vm_pool.live > 2 and time.monotonic() < deadline:
+        time.sleep(0.01)
+    kills[1].clear()
+    assert mgr.vm_pool.resize(3)["spawned"] == [1]
+    while mgr.vm_pool.live < 3 and time.monotonic() < deadline:
+        time.sleep(0.01)
+    assert mgr.vm_pool.live == 3
+    # scale down retires the top index
+    assert mgr.scale_vms(1) == 1
+    while mgr.vm_pool.live > 1 and time.monotonic() < deadline:
+        time.sleep(0.01)
+    assert mgr.vm_pool.indices() == [0]
+    mgr.stop()
+    assert mgr.vm_pool.live == 0
+
+
+def test_restart_component_snapshots_then_swaps(tmp_path, table):
+    mgr = make_mgr(tmp_path / "w", table)
+    for inp in chaos.synth_inputs(table, 3, seed=6):
+        chaos._admit_direct(mgr, inp)
+    old_stream, old_coal = mgr.dstream, mgr.coalescer
+    snaps_before = int(mgr.checkpointer.stat_snapshots)
+    mgr.restart_component("dstream")
+    assert mgr.dstream is not old_stream
+    mgr.restart_component("coalescer")
+    assert mgr.coalescer is not old_coal and mgr.coalescer is not None
+    # the autopilot checkpoints before any controlled restart
+    assert mgr.checkpointer.stat_snapshots == snaps_before + 2
+    # the swapped-in components serve (Poll draws choices, admission
+    # flows through the fresh coalescer)
+    r = mgr.rpc_poll({"name": "vm0"})
+    assert len(r["choices"]) > 0
+    chaos._admit_direct(mgr, chaos.synth_inputs(table, 5, seed=61)[4])
+    with pytest.raises(ValueError):
+        mgr.restart_component("nonsense")
+    mgr.stop()
+
+
+def test_healthz_endpoint_manager(tmp_path, table):
+    from syzkaller_tpu.manager import html
+
+    mgr = make_mgr(tmp_path / "w", table)
+    srv = html.serve(mgr, "127.0.0.1", 0)
+    host, port = srv.server_address
+    url = f"http://{host}:{port}/healthz"
+    try:
+        with urllib.request.urlopen(url, timeout=10) as resp:
+            assert resp.status == 200
+            body = json.loads(resp.read().decode())
+        assert body["status"] == "ok"
+        # drive one component to DEGRADED → non-200 with the component
+        # named in the body
+        for _ in range(4):
+            mgr.autopilot.health.observe("backend", False, "forced")
+        with pytest.raises(urllib.error.HTTPError) as exc:
+            urllib.request.urlopen(url, timeout=10)
+        assert exc.value.code == 503
+        body = json.loads(exc.value.read().decode())
+        assert body["status"] == "degraded"
+        assert body["components"]["backend"]["state"] == "DEGRADED"
+    finally:
+        srv.shutdown()
+        mgr.stop()
+
+
+def test_healthz_endpoint_hub():
+    from types import SimpleNamespace
+
+    from syzkaller_tpu.hub import http as hub_http
+    from syzkaller_tpu.telemetry import Registry
+
+    hub = SimpleNamespace(
+        state=SimpleNamespace(seq=[], managers={}),
+        registry=Registry())
+    srv = hub_http.serve(hub, "127.0.0.1", 0)
+    host, port = srv.server_address
+    try:
+        with urllib.request.urlopen(
+                f"http://{host}:{port}/healthz", timeout=10) as resp:
+            assert resp.status == 200
+            body = json.loads(resp.read().decode())
+        assert body["status"] == "ok" and body["managers"] == 0
+    finally:
+        srv.shutdown()
+
+
+# -- admission overload protection -------------------------------------------
+
+
+def test_coalescer_sheds_oldest_under_overload(tmp_path, table):
+    """Bounded queue: past the cap the OLDEST pending admission is
+    shed with the 'shed' reply (counted), the newest still admits, and
+    nothing blocks past the deadline scale."""
+    mgr = make_mgr(tmp_path / "w", table, admit_batch=4,
+                   admit_queue_cap=4, admit_shed_deadline=0.0)
+    prim = getattr(mgr.engine, "primary", mgr.engine)
+    orig = prim.admit_batch
+
+    def slow(*a, **k):
+        time.sleep(0.05)
+        return orig(*a, **k)
+
+    prim.admit_batch = slow
+    inputs = chaos.synth_inputs(table, 48, seed=15)
+    results = []
+    res_mu = threading.Lock()
+
+    def send(chunk):
+        for inp in chunk:
+            r = chaos._admit_direct(mgr, inp, name="storm")
+            with res_mu:
+                results.append(r)
+
+    threads = [threading.Thread(target=send, args=(inputs[i::12],),
+                                daemon=True) for i in range(12)]
+    t0 = time.monotonic()
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=60)
+    assert time.monotonic() - t0 < 60
+    shed = [r for r in results if r.get("shed")]
+    assert len(shed) > 0
+    assert int(mgr._c_shed.value) == len(shed)
+    # shed ≠ lost for the system: non-shed inputs admitted normally
+    assert len(mgr.corpus) == len(results) - len(shed)
+    prim.admit_batch = orig
+    mgr.stop()
+
+
+def test_coalescer_deadline_shed(tmp_path, table):
+    """Entries that waited past admit_shed_deadline are shed at drain
+    time (the drain is not keeping up = genuine overload)."""
+    mgr = make_mgr(tmp_path / "w", table, admit_batch=4,
+                   admit_queue_cap=0, admit_shed_deadline=0.02)
+    prim = getattr(mgr.engine, "primary", mgr.engine)
+    orig = prim.admit_batch
+
+    def very_slow(*a, **k):
+        time.sleep(0.2)
+        return orig(*a, **k)
+
+    prim.admit_batch = very_slow
+    inputs = chaos.synth_inputs(table, 12, seed=19)
+    results = []
+    res_mu = threading.Lock()
+
+    def send(inp):
+        r = chaos._admit_direct(mgr, inp, name="late")
+        with res_mu:
+            results.append(r)
+
+    threads = [threading.Thread(target=send, args=(inp,), daemon=True)
+               for inp in inputs]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=60)
+    assert any(r.get("shed") for r in results)
+    assert int(mgr._c_shed.value) >= 1
+    prim.admit_batch = orig
+    mgr.stop()
+
+
+def test_fuzzer_shed_backoff_window(tmp_path, table):
+    """A 'shed' reply opens a doubling local-only window; a clean ack
+    resets it."""
+    from syzkaller_tpu.fuzzer.fuzzer import Fuzzer
+
+    fz = Fuzzer("t0", "127.0.0.1:1", table=table)
+    assert not fz._shed_active()
+    fz._note_delivery_reply({"shed": True})
+    assert fz._shed_active()                     # window open
+    assert int(fz._c_local_only.value) == 1
+    assert fz._shed_backoff == 2.0               # doubled
+    fz._note_delivery_reply({"shed": True})
+    assert fz._shed_backoff == 4.0
+    fz._note_delivery_reply({})                  # clean ack resets
+    assert fz._shed_backoff == 1.0
+    assert int(fz._c_shed_replies.value) == 2
+
+
+# -- satellites --------------------------------------------------------------
+
+
+def test_snapshot_now_and_cadence_resume(tmp_path, table):
+    """snapshot_now works with the periodic cadence disabled, and a
+    restored manager resumes the cadence from the restored snapshot's
+    timestamp instead of restarting the timer from zero."""
+    mgr = make_mgr(tmp_path / "w", table, snapshot_interval=0.0)
+    for inp in chaos.synth_inputs(table, 4, seed=23):
+        chaos._admit_direct(mgr, inp)
+    assert mgr.checkpointer.interval == 0.0
+    assert mgr.checkpointer.maybe_snapshot() is None
+    path = mgr.checkpointer.snapshot_now()       # on-demand still works
+    assert path is not None
+    mgr.stop()
+
+    # restart with a long interval: the cadence must read the restored
+    # snapshot's age, so a snapshot fires as soon as that age crosses
+    # the interval — not a full interval after process start
+    mgr2 = make_mgr(tmp_path / "w", table, snapshot_interval=3600.0)
+    assert int(mgr2._f_restore.labels(outcome="snapshot").value) == 1
+    age = time.monotonic() - mgr2.checkpointer._last
+    assert age >= 0.0
+    # seed an artificially old timestamp and watch the cadence fire
+    # immediately (the drift bug made this wait the whole interval)
+    mgr2.checkpointer.seed_cadence(time.time() - 7200.0)
+    assert mgr2.checkpointer.maybe_snapshot() is not None
+    mgr2.stop()
+
+
+def test_scheduler_cluster_aware_rotation():
+    """maybe_rotate picks the campaign with growing crash clusters over
+    the round-robin next."""
+    now = [0.0]
+    sched = CampaignScheduler(["a", "b", "c"], rotation=5.0,
+                              min_execs=100, tau=30.0,
+                              now=lambda: now[0])
+    sched.assign("vm0")                          # → "a"
+    assert sched.current("vm0") == "a"
+    # campaign c (NOT the round-robin next) grows crash clusters
+    sched.force_assign("vmc", "c")
+    for i in range(5):
+        now[0] += 1.0
+        sched.note_cluster("vmc", f"cl-{i}")
+    assert sched.cluster_rate("c") > 0.0
+    # decay vm0's campaign: execs flow, cov dries up
+    for _ in range(150):
+        now[0] += 1.0
+        sched.note_execs("vm0", 50)
+    assert sched.maybe_rotate("vm0") == "c"      # toward clusters, not b
+
+
+def test_reap_and_rotate_exactly_once(tmp_path, table):
+    """Satellite: a reaped connection's campaign assignment returns to
+    the pool exactly once even when the autopilot rotates campaigns in
+    the same tick — in either order."""
+    mgr = make_mgr(tmp_path / "w", table, conn_timeout=5.0)
+    sched = mgr.campaign_sched
+    sched.register_campaign("camp-a")
+    sched.register_campaign("camp-b")
+
+    # order 1: reap first, rotate second — the dead conn must not be
+    # resurrected by the rotation
+    with mgr._mu:
+        mgr.fuzzers["vmX"] = FuzzerConn(name="vmX")
+    sched.force_assign("vmX", "camp-a")
+    with mgr._mu:
+        mgr.fuzzers["vmX"].last_seen -= 60.0
+    assert mgr.reap_dead_conns() == ["vmX"]
+    assert sched.current("vmX") is None
+    assert mgr.rotate_campaign("camp-a", "camp-b") == []
+    assert sched.current("vmX") is None          # still free
+    assert sched.assigned_count("camp-a") == 0
+    assert sched.assigned_count("camp-b") == 0
+
+    # order 2: rotate first, reap second — the assignment moves once,
+    # then frees once
+    with mgr._mu:
+        mgr.fuzzers["vmY"] = FuzzerConn(name="vmY")
+    sched.force_assign("vmY", "camp-a")
+    assert mgr.rotate_campaign("camp-a", "camp-b") == ["vmY"]
+    assert sched.current("vmY") == "camp-b"
+    with mgr._mu:
+        mgr.fuzzers["vmY"].last_seen -= 60.0
+    assert mgr.reap_dead_conns() == ["vmY"]
+    assert sched.current("vmY") is None
+    # double-drop stays a no-op
+    sched.drop("vmY")
+    assert sched.assigned_count("camp-b") == 0
+    # a fresh connection still gets a clean round-robin assignment
+    assert sched.assign("vmZ") in ("camp-a", "camp-b")
+    mgr.stop()
+
+
+def test_scheduler_cluster_state_snapshots():
+    sched = CampaignScheduler(["a", "b"])
+    sched.force_assign("vm0", "a")
+    sched.note_cluster("vm0", "cl-1")
+    sched.note_cluster("vm0", "cl-2")
+    sched.note_cluster("vm0", "cl-2")            # repeat: no growth
+    st = sched.export_state()
+    assert st["clusters"]["a"] == ["cl-1", "cl-2"]
+    sched2 = CampaignScheduler(["a", "b"])
+    sched2.import_state(st)
+    assert sched2.clusters("a") == {"cl-1", "cl-2"}
+
+
+# -- the compound-failure acceptance cycle -----------------------------------
+
+
+def test_autopilot_compound_failure_chaos(tmp_path):
+    """Acceptance: kill 2 of N VM threads + backend flap + one wedged
+    campaign, all mid-admission-storm — the autopilot detects and
+    fully remediates (capacity restored, backend promoted, campaign
+    rotated toward growing clusters) within a bounded budget, with
+    zero corpus loss (bit-exact vs serial replay), zero warm recompiles
+    across the promotion, and no breaker trip (every action class fired
+    effectively, once)."""
+    out = chaos.run_autopilot_cycle(str(tmp_path), n_inputs=16)
+    assert out["recovered"] is True
+    assert out["frontier_bit_exact"] is True
+    assert out["corpus_lost"] == 0
+    assert out["post_promotion_recompiles"] == 0
+    assert out["breaker_trips"] == 0
+    assert out["autopilot_recover_seconds"] < 30.0
+    fired = [(a["action"], a["component"]) for a in out["actions"]
+             if a["outcome"] == "fired"]
+    assert ("promote", "backend") in fired
+    assert ("scale_up", "vm_pool") in fired
+    assert ("rotate", "camp-wedged") in fired

@@ -1,0 +1,90 @@
+#!/usr/bin/env python3
+"""Remote fleet autopilot: the SAME control-loop policy the manager
+runs in-process, driven from outside over a manager's /metrics
+endpoint — observe mode.
+
+    python tools/autopilot.py --metrics http://host:port/metrics
+    python tools/autopilot.py --metrics ... --interval 5
+    python tools/autopilot.py --metrics ... --once
+    python tools/autopilot.py --healthz http://host:port/healthz
+
+Each tick scrapes /metrics, runs the health state machines + policy,
+and prints ONE JSON line: per-component health states and the actions
+the in-process autopilot would fire (outcome "observe_only" — a remote
+controller has no seams to act through; the manager's own autopilot
+executes, this one watches).  Feed the lines to a dashboard, or use
+--once in CI as a fleet health probe (exit 0 = nothing DEGRADED).
+
+--healthz skips the policy entirely and round-trips the manager's own
+/healthz (exit code follows the HTTP status) — the thinnest possible
+external probe.
+"""
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+def probe_healthz(url: str) -> int:
+    import urllib.error
+    import urllib.request
+
+    try:
+        with urllib.request.urlopen(url, timeout=10.0) as resp:
+            body = json.loads(resp.read().decode())
+            code = resp.status
+    except urllib.error.HTTPError as e:
+        body = json.loads(e.read().decode() or "{}")
+        code = e.code
+    except Exception as e:
+        print(json.dumps({"error": str(e)}))
+        return 2
+    print(json.dumps({"code": code, **body}))
+    return 0 if code == 200 else 1
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--metrics", help="manager /metrics URL to scrape")
+    ap.add_argument("--healthz", help="round-trip a /healthz URL instead")
+    ap.add_argument("--interval", type=float, default=5.0,
+                    help="tick cadence in seconds (default 5)")
+    ap.add_argument("--once", action="store_true",
+                    help="one tick, exit 0 iff nothing is DEGRADED")
+    ap.add_argument("--ticks", type=int, default=0,
+                    help="stop after N ticks (0 = run until ^C)")
+    args = ap.parse_args(argv)
+
+    if args.healthz:
+        return probe_healthz(args.healthz)
+    if not args.metrics:
+        ap.error("--metrics or --healthz is required")
+
+    from syzkaller_tpu.autopilot import (
+        Autopilot, HttpSource, ReportExecutor, State)
+
+    pilot = Autopilot(HttpSource(args.metrics), ReportExecutor(),
+                      interval=args.interval)
+    n = 0
+    while True:
+        try:
+            report = pilot.tick()
+        except Exception as e:
+            report = {"error": str(e)}
+        print(json.dumps(report, default=str), flush=True)
+        n += 1
+        if args.once or (args.ticks and n >= args.ticks):
+            break
+        time.sleep(args.interval)
+    if args.once:
+        return 0 if pilot.health.worst() < State.DEGRADED else 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -28,9 +28,13 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--smoke", action="store_true",
-                    help="single fast kill/restore cycle (presubmit)")
+                    help="fast kill/restore + autopilot cycles (presubmit)")
     ap.add_argument("--inputs", type=int, default=None,
                     help="NewInput storm size (default 32 smoke, 128 full)")
+    ap.add_argument("--autopilot-only", action="store_true",
+                    help="run only the autopilot compound-failure cycle")
+    ap.add_argument("--no-autopilot", action="store_true",
+                    help="run only the SIGKILL kill/restore cycle")
     ap.add_argument("--keep", action="store_true",
                     help="keep the scratch workdirs for inspection")
     ap.add_argument("-v", action="store_true")
@@ -40,11 +44,26 @@ def main(argv=None) -> int:
     from syzkaller_tpu.resilience import chaos
 
     n = args.inputs or (32 if args.smoke else 128)
+    verbose = args.v or not args.smoke
     base = tempfile.mkdtemp(prefix="syz-chaos-")
     try:
-        out = chaos.run_kill_restore_cycle(base, n_inputs=n,
-                                           verbose=args.v or not args.smoke)
-        out["inputs"] = n
+        out = {}
+        if not args.autopilot_only:
+            out = chaos.run_kill_restore_cycle(base, n_inputs=n,
+                                               verbose=verbose)
+            out["inputs"] = n
+        if not args.no_autopilot:
+            # the compound-failure cycle: kill 2 of N VM threads + flap
+            # the backend + wedge a campaign, autopilot remediates all
+            # three with zero operator input
+            ab = chaos.run_autopilot_cycle(
+                base, n_inputs=min(n, 32), verbose=verbose)
+            out["autopilot"] = {
+                k: ab[k] for k in (
+                    "autopilot_detect_seconds",
+                    "autopilot_recover_seconds", "frontier_bit_exact",
+                    "corpus_lost", "post_promotion_recompiles",
+                    "breaker_trips", "recovered")}
         print(json.dumps(out))
         return 0
     except (AssertionError, TimeoutError) as e:
