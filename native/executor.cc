@@ -61,6 +61,7 @@ static int kInFd = 3;
 static int kOutFd = 4;
 static int kReqFd = 5;
 static int kRepFd = 6;
+static int kRingFd = -1; // optional PC slab ring (argv[5])
 
 const size_t kInSize = 2 << 20;
 const size_t kOutSize = 16 << 20;
@@ -96,6 +97,7 @@ const uint64_t FLAG_SANDBOX_SETUID = 1 << 5;
 const uint64_t FLAG_SANDBOX_NAMESPACE = 1 << 6;
 const uint64_t FLAG_FAKE_COVER = 1 << 7;
 const uint64_t FLAG_ENABLE_TUN = 1 << 8;
+const uint64_t FLAG_RING_SKIP = 1 << 9; // this exec's covers skip the ring
 
 // exit statuses (ref common.h:46-48, decoded by ipc/env.py)
 const int kFailStatus = 67;
@@ -109,6 +111,7 @@ const uint64_t kCoverSize = 64 << 10;
 
 uint64_t flag_debug, flag_cover, flag_threaded, flag_collide, flag_fake_cover;
 uint64_t flag_dedup, flag_sandbox_setuid, flag_sandbox_namespace;
+uint64_t flag_ring_skip;
 uint64_t proc_pid;
 
 char* input_data;
@@ -823,6 +826,99 @@ struct Thread {
 static Thread threads[kMaxThreads];
 static pthread_mutex_t output_mu = PTHREAD_MUTEX_INITIALIZER;
 
+// ---------------------------------------------------------------------------
+// PC slab ring (zero-copy executor→device ingest). The wire layout is
+// defined in syzkaller_tpu/ipc/ring.py and mirrored here word for word:
+// a 128-byte header, an index ring of 16-byte records {commit, tag,
+// npcs, off_words}, and a u32 data ring of raw PCs in pow2-bucketed
+// slabs. Single writer (we run under output_mu); commit protocol:
+// record fields with commit=0 → release-publish the reservation →
+// payload → release-store commit=1, so the Python reader never sees a
+// torn slab and can skip an uncommitted one by its length prefix if we
+// die mid-write. Ring-full is a counted drop, never a blocked exec.
+
+struct RingHdr {
+	uint64_t magic; // 'SYZRING1'
+	uint32_t version;
+	uint32_t slab_cap;
+	uint64_t index_slots;
+	uint64_t data_words;
+	uint64_t resv_idx;
+	uint64_t head_words;
+	uint64_t consumed_idx;
+	uint64_t tail_words;
+	uint64_t dropped_full;
+	uint64_t wasted_words;
+	uint64_t skipped_uncommitted;
+	uint64_t min_bucket; // quantize small slabs: long same-bucket runs
+	uint64_t pad[4];
+};
+
+const uint64_t kRingMagic = 0x53595A52494E4731ull;
+const uint32_t kRingMinBucket = 8;
+
+static RingHdr* ring_hdr;
+static uint32_t* ring_index; // index_slots * 4 u32 words
+static uint32_t* ring_pcs;   // data_words u32 words
+
+static void ring_attach(int fd)
+{
+	struct stat st;
+	if (fstat(fd, &st) || (size_t)st.st_size < sizeof(RingHdr))
+		return;
+	char* m = (char*)mmap(NULL, st.st_size, PROT_READ | PROT_WRITE,
+			      MAP_SHARED, fd, 0);
+	if (m == MAP_FAILED)
+		return;
+	RingHdr* h = (RingHdr*)m;
+	if (h->magic != kRingMagic)
+		return;
+	ring_hdr = h;
+	ring_index = (uint32_t*)(m + sizeof(RingHdr));
+	ring_pcs = ring_index + h->index_slots * 4;
+}
+
+static void ring_write(uint32_t tag, uint32_t* pcs, uint32_t n)
+{
+	// caller holds output_mu: single-writer protocol
+	RingHdr* h = ring_hdr;
+	if (!h || n == 0)
+		return;
+	if (n > h->slab_cap)
+		n = h->slab_cap;
+	uint64_t bucket = kRingMinBucket;
+	if (h->min_bucket > bucket)
+		bucket = h->min_bucket;
+	while (bucket < n)
+		bucket <<= 1;
+	uint64_t resv = h->resv_idx;
+	uint64_t cons = __atomic_load_n(&h->consumed_idx, __ATOMIC_ACQUIRE);
+	if (resv - cons >= h->index_slots) {
+		h->dropped_full++;
+		return;
+	}
+	uint64_t head = h->head_words;
+	uint64_t tail = __atomic_load_n(&h->tail_words, __ATOMIC_ACQUIRE);
+	uint64_t dw = h->data_words;
+	uint64_t rem = dw - head % dw;
+	uint64_t skip = bucket > rem ? rem : 0;
+	if (head + skip + bucket - tail > dw) {
+		h->dropped_full++;
+		return;
+	}
+	uint64_t off = (head + skip) % dw;
+	uint32_t* rec = ring_index + (resv % h->index_slots) * 4;
+	__atomic_store_n(&rec[0], 0u, __ATOMIC_RELAXED); // commit=0 first
+	rec[1] = tag;
+	rec[2] = n;
+	rec[3] = (uint32_t)off;
+	h->wasted_words += skip;
+	h->head_words = head + skip + bucket;
+	__atomic_store_n(&h->resv_idx, resv + 1, __ATOMIC_RELEASE);
+	memcpy(ring_pcs + off, pcs, n * 4);
+	__atomic_store_n(&rec[0], 1u, __ATOMIC_RELEASE);
+}
+
 static void write_output(Call* c, long retval, int err, uint32_t* cover,
 			 uint32_t n)
 {
@@ -839,6 +935,8 @@ static void write_output(Call* c, long retval, int err, uint32_t* cover,
 		uint32_t* count = (uint32_t*)output_data;
 		__atomic_fetch_add(count, 1, __ATOMIC_SEQ_CST);
 	}
+	if (flag_cover && !flag_ring_skip)
+		ring_write(c->index, cover, n);
 	pthread_mutex_unlock(&output_mu);
 	if (c->result_idx != no_result)
 		result_publish(c->result_idx, (uint64_t)retval);
@@ -1407,6 +1505,11 @@ int main(int argc, char** argv)
 		kReqFd = atoi(argv[3]);
 		kRepFd = atoi(argv[4]);
 	}
+	if (argc >= 6) {
+		kRingFd = atoi(argv[5]);
+		if (kRingFd >= 0)
+			ring_attach(kRingFd);
+	}
 	input_data = (char*)mmap(NULL, kInSize, PROT_READ, MAP_SHARED, kInFd, 0);
 	if (input_data == MAP_FAILED)
 		fail("mmap of input shm failed");
@@ -1440,6 +1543,7 @@ int main(int argc, char** argv)
 		flag_sandbox_setuid = flags & FLAG_SANDBOX_SETUID;
 		flag_sandbox_namespace = flags & FLAG_SANDBOX_NAMESPACE;
 		flag_fake_cover = flags & FLAG_FAKE_COVER;
+		flag_ring_skip = flags & FLAG_RING_SKIP;
 		if (flags & FLAG_ENABLE_TUN)
 			initialize_tun(proc_pid); // once; workers inherit the fd
 

@@ -85,6 +85,13 @@ def pack_pcs(pc_idx: jax.Array, valid: jax.Array, npcs: int,
     """(B, K) int32 PC indices + mask → (B, W) uint32 packed bitmaps.
     Invalid/masked indices are dropped.
 
+    Two formulations, picked by backend at trace time: the MXU one-hot
+    matmul below for accelerators (scatter measured ~25M elems/s there),
+    and a scatter-add for the CPU backend (`_pack_pcs_scatter` — the
+    one-hot operands cost ~12x more than the scatter on CPU, and the
+    presubmit/smoke/fallback paths all run CPU).  Both are bit-exact
+    for the same inputs.
+
     MXU formulation — no gather/scatter (measured at only ~25M random
     elems/s on this backend, the old bottleneck): factor each word index
     as (hi, lo) with 64 words per hi-group and split each word into 5
@@ -112,6 +119,8 @@ def pack_pcs(pc_idx: jax.Array, valid: jax.Array, npcs: int,
         dup = jnp.concatenate(
             [jnp.zeros((B, 1), bool), s[:, 1:] == s[:, :-1]], axis=1)
         keep = (s < npcs) & ~dup
+    if jax.default_backend() == "cpu":
+        return _pack_pcs_scatter(s, keep, npcs)
     word = s >> 5
     sub = s & 31
     hi = word >> 6
@@ -131,6 +140,23 @@ def pack_pcs(pc_idx: jax.Array, valid: jax.Array, npcs: int,
     words = (planes[..., 0] | (planes[..., 1] << 7) | (planes[..., 2] << 14)
              | (planes[..., 3] << 21) | (planes[..., 4] << 28))
     return words.reshape(B, W)
+
+
+def _pack_pcs_scatter(pc: jax.Array, keep: jax.Array,
+                      npcs: int) -> jax.Array:
+    """CPU-backend pack: one scatter-ADD of per-PC bit values.  The
+    caller guarantees kept indices are unique per row, so two kept PCs
+    sharing a word always carry different bits — add IS bitwise-or.
+    Dropped entries scatter out of bounds (mode='drop')."""
+    B, K = pc.shape
+    W = nwords_for(npcs)
+    word = jnp.where(keep, pc >> 5, jnp.int32(W))
+    bitv = jnp.where(keep,
+                     jnp.uint32(1) << (pc & 31).astype(jnp.uint32),
+                     jnp.uint32(0))
+    rows = jnp.broadcast_to(jnp.arange(B)[:, None], (B, K))
+    out = jnp.zeros((B, W), jnp.uint32)
+    return out.at[rows, word].add(bitv, mode="drop")
 
 
 def scatter_or(base: jax.Array, call_ids: jax.Array,
@@ -315,6 +341,47 @@ def sparse_update(max_cover: jax.Array, call_ids: jax.Array,
     mc = max_cover.reshape(ncalls, NB, block_words).at[:, blocks].set(
         merged_sub.reshape(ncalls, MB, block_words), mode="drop")
     return mc.reshape(ncalls, W), new, has_new
+
+
+def translate_slab_rows(win: jax.Array, counts: jax.Array,
+                        skeys: jax.Array, svals: jax.Array,
+                        meta: jax.Array, direct_cap: int, overflow: int
+                        ) -> tuple[jax.Array, jax.Array, jax.Array]:
+    """On-device sparse→dense PC translation for one slab batch: the
+    PcMap's first-seen key table, mirrored as a sorted device array
+    (fuzzer/pcmap.py DeviceKeyMirror), probed with one vmapped binary
+    search per PC — the same O(log n)-per-element trick as the
+    decision-stream cdf draw, replacing the per-batch host
+    `_lookup`/scatter/dedup/pad packing that kept device replay behind
+    the CPU path.
+
+    win: (B, K) uint32 raw PCs (row i live in [:counts[i]]) — exactly
+    the ring's zero-copy slab window.  skeys/svals: (D,) sorted keys
+    (0xFFFFFFFF sentinel padding) and their dense indices.  meta: (2,)
+    int32 [n_live_keys, table_full].
+
+    Semantics match the host `_lookup` bit for bit: a hit returns the
+    stored dense index; a miss with the direct table FULL takes the
+    stateless hashed-overflow index (`direct_cap + pc % overflow`, the
+    `_map_flat_locked` formula — u32 and u64 mod agree on u32 values);
+    a miss with room left is a NEW key the caller must resolve
+    host-side (returned in the miss mask) — the kernel cannot assign
+    first-seen order.  Returns (idx, valid, miss)."""
+    B, K = win.shape
+    D = skeys.shape[0]
+    col = jnp.arange(K, dtype=jnp.int32)
+    in_row = col[None, :] < counts[:, None]
+    pos = jnp.searchsorted(skeys, win, side="left")
+    pos_c = jnp.clip(pos, 0, D - 1)
+    hit = (skeys[pos_c] == win) & (pos < meta[0])
+    idx = jnp.where(hit, svals[pos_c], jnp.int32(-1))
+    ovf = (win % jnp.uint32(overflow)).astype(jnp.int32) + direct_cap
+    table_full = meta[1] > 0
+    take_ovf = in_row & ~hit & table_full
+    idx = jnp.where(take_ovf, ovf, idx)
+    valid = in_row & (hit | take_ovf)
+    miss = in_row & ~hit & ~table_full
+    return idx, valid, miss
 
 
 def popcount_rows(mat: jax.Array) -> jax.Array:
@@ -671,6 +738,21 @@ class SparseUpdateResult:
     blocks: "np.ndarray | None"  # (MB,) touched block ids; None = dense
 
 
+@dataclass
+class IngestResult:
+    """One zero-copy slab-batch ingest dispatch (translate + pack +
+    diff/merge fused): every field is a device array the caller fetches
+    at resolve time — the dispatch itself never syncs.  `miss_rows`
+    marks slabs that contained first-sight PCs (the direct table had
+    room, so the kernel could not assign them): the caller resolves
+    those rows host-side once per batch and fixes up with one bounded
+    extra dispatch."""
+    has_new: jax.Array          # (B,) bool
+    new_bits: jax.Array         # (B, W) full-width diff bitmaps
+    miss_rows: jax.Array        # (B,) bool — rows needing host key resolve
+    blocks: None = None         # SparseView.absorb compatibility (dense)
+
+
 class CoverageEngine:
     """Device-resident fuzzing state (SURVEY §7 architecture stance).
 
@@ -988,6 +1070,103 @@ class CoverageEngine:
                     jnp.int32((ncalls + 1) * per_row + hot_prev.shape[0]))
             return key, base, hot, crows, ent, svec
 
+        # -- zero-copy slab ingest: the PcMap translation runs ON DEVICE
+        # (sorted-mirror binary search, translate_slab_rows) inside the
+        # same fused dispatch as pack/diff/merge, so a slab batch goes
+        # ring view → device with no host packing at all.  direct_cap/
+        # overflow are static (one PcMap config per engine lifetime).
+
+        @functools.partial(jax.jit, donate_argnums=(0,),
+                           static_argnums=(8, 9))
+        def _ingest_update(max_cover, win, counts, call_ids, skeys,
+                           svals, meta, svec, direct_cap, overflow, hinc):
+            idx, valid, miss = translate_slab_rows(
+                win, counts, skeys, svals, meta, direct_cap, overflow)
+            # overflow aliasing can duplicate an index within a row —
+            # sort-dedup inside the pack (host map_rows dedups too)
+            bitmaps = pack_pcs(idx, valid, npcs, assume_unique=False)
+            merged, new, has_new = diff_merge(max_cover, call_ids, bitmaps)
+            miss_rows = jnp.any(miss, axis=1)
+            if ds is not None:
+                svec = _bump(svec, hinc, "dense_batches", "dense_rows",
+                             "dense_newsig", valid, has_new)
+                svec = svec.at[ds.slot("ingest_batches")].add(1)
+                svec = svec.at[ds.slot("ingest_slabs")].add(
+                    jnp.sum(counts > 0, dtype=jnp.int32))
+                svec = svec.at[ds.slot("ingest_bytes")].add(
+                    jnp.sum(counts, dtype=jnp.int32) * 4)
+            return merged, new, has_new, miss_rows, svec
+
+        @functools.partial(jax.jit, donate_argnums=(0, 1),
+                           static_argnums=(15, 16))
+        def _ingest_admit(corpus_cover, corpus_mat, flakes, win, counts,
+                          call_ids, start, key, prios, enabled, prev,
+                          skeys, svals, meta, svec, direct_cap, overflow,
+                          hinc):
+            """The coalescer's zero-copy step: on-device translation
+            fused with the batched admission gate + merge + choice
+            draws — the host-side map_batch scatter/dedup/pad is
+            retired.  The caller pre-resolves first-sight keys
+            (DeviceKeyMirror.ensure), so misses cannot occur; the mask
+            still rides back as a cheap invariant check."""
+            idx, valid, miss = translate_slab_rows(
+                win, counts, skeys, svals, meta, direct_cap, overflow)
+            bitmaps = pack_pcs(idx, valid, npcs, assume_unique=False)
+            gate = jnp.bitwise_or(corpus_cover, flakes)
+            _g, _new, has_new = diff_merge(gate, call_ids, bitmaps)
+            rowbits = popcount_rows(_new)
+            rows = jnp.where(has_new[:, None], bitmaps, jnp.uint32(0))
+            cover = scatter_or(corpus_cover, call_ids, rows)
+            ridx = jnp.cumsum(has_new.astype(jnp.int32)) - 1 + start
+            ridx = jnp.where(has_new, ridx, corpus_mat.shape[0])
+            mat = corpus_mat.at[ridx].set(bitmaps, mode="drop")
+            draws = sample_calls(key, prios, prev, enabled)
+            miss_rows = jnp.any(miss, axis=1)
+            if ds is not None:
+                svec = _bump(svec, hinc, "admit_batches", "admit_inputs",
+                             "admit_admitted", valid, has_new,
+                             extra=[("admit_draws", prev.shape[0])])
+                svec = svec.at[ds.slot("ingest_batches")].add(1)
+                svec = svec.at[ds.slot("ingest_slabs")].add(
+                    jnp.sum(counts > 0, dtype=jnp.int32))
+                svec = svec.at[ds.slot("ingest_bytes")].add(
+                    jnp.sum(counts, dtype=jnp.int32) * 4)
+            return cover, mat, has_new, rowbits, draws, miss_rows, svec
+
+        @functools.partial(jax.jit, static_argnums=(8, 9))
+        def _ingest_diff(base, flakes, win, counts, call_ids, skeys,
+                         svals, meta, direct_cap, overflow):
+            """Translate + diff-vs-(base ∪ flakes), no state mutation —
+            the triage-gate slab path.  Returns the translated index
+            rows too: the caller reads each PC's verdict through its
+            own index (overflow aliasing degrades to a shared verdict,
+            matching the host path)."""
+            idx, valid, miss = translate_slab_rows(
+                win, counts, skeys, svals, meta, direct_cap, overflow)
+            bitmaps = pack_pcs(idx, valid, npcs, assume_unique=False)
+            prev = base[call_ids]
+            fl = flakes[call_ids]
+            new = jnp.bitwise_and(bitmaps,
+                                  jnp.bitwise_not(jnp.bitwise_or(prev, fl)))
+            return (new, jnp.any(new != 0, axis=-1), bitmaps, idx,
+                    jnp.any(miss, axis=1))
+
+        @functools.partial(jax.jit, static_argnums=(5, 6))
+        def _ingest_pack(win, counts, skeys, svals, meta, direct_cap,
+                         overflow):
+            idx, valid, _miss = translate_slab_rows(
+                win, counts, skeys, svals, meta, direct_cap, overflow)
+            return pack_pcs(idx, valid, npcs, assume_unique=False)
+
+        @functools.partial(jax.jit, static_argnums=(5, 6))
+        def _ingest_pack_or(win, counts, skeys, svals, meta, direct_cap,
+                            overflow):
+            idx, valid, _miss = translate_slab_rows(
+                win, counts, skeys, svals, meta, direct_cap, overflow)
+            bm = pack_pcs(idx, valid, npcs, assume_unique=False)
+            return jax.lax.reduce(bm, jnp.uint32(0), jax.lax.bitwise_or,
+                                  [0])[None, :]
+
         @jax.jit
         def _popcount(mat):
             return popcount_rows(mat)
@@ -1045,6 +1224,11 @@ class CoverageEngine:
             return mc, hn
 
         self._random_bits_fn = _random_bits
+        self._ingest_update_fn = _ingest_update
+        self._ingest_admit_fn = _ingest_admit
+        self._ingest_diff_fn = _ingest_diff
+        self._ingest_pack_fn = _ingest_pack
+        self._ingest_pack_or_fn = _ingest_pack_or
         self._decision_fn = _decision
         self._popcount_fn = _popcount
         self._pack_fn = _pack
@@ -1192,6 +1376,117 @@ class CoverageEngine:
                 jnp.asarray(packed, jnp.int32),
                 jnp.asarray(counts, jnp.int32))
         return has_new
+
+    # -- zero-copy slab ingest (ring → device, PcMap translation fused) --
+
+    @staticmethod
+    def _mirror_ops(mirror):
+        skeys, svals, meta = mirror.operands()
+        pm = mirror.pcmap
+        return skeys, svals, meta, pm.direct_cap, pm.overflow
+
+    def _slab_fit(self, win, counts, call_ids=None):
+        win = jnp.asarray(win)          # (B, K) uint32 ring view
+        counts = jnp.asarray(counts, jnp.int32)
+        if call_ids is None:
+            return win, counts
+        return win, counts, jnp.asarray(call_ids, jnp.int32)
+
+    @_locked
+    def ingest_update_slabs(self, win, counts, call_ids,
+                            mirror) -> IngestResult:
+        """The zero-copy hot step: one fused dispatch translates a raw
+        slab window (on-device binary search over the PcMap mirror),
+        packs, diffs vs max cover and merges — no host packing, no
+        host sync (fields are device arrays the caller fetches later).
+        Rows flagged in miss_rows carried first-sight PCs: resolve
+        them host-side (PcMap keeps first-seen order) and fix up with
+        update_batch — DeviceSignal.resolve does exactly that."""
+        win, counts, call_ids = self._slab_fit(win, counts, call_ids)
+        skeys, svals, meta, dc, ov = self._mirror_ops(mirror)
+        svec, hinc = self._ts_in()
+        (self.max_cover, new, has_new, miss_rows,
+         svec) = self._ingest_update_fn(
+            self.max_cover, win, counts, call_ids, skeys, svals, meta,
+            svec, dc, ov, hinc)
+        self._ts_out(svec)
+        return IngestResult(has_new=has_new, new_bits=new,
+                            miss_rows=miss_rows)
+
+    @_locked
+    def admit_slabs(self, win, counts, call_ids, choice_prev, mirror,
+                    with_new_bits: bool = False):
+        """admit_batch over a raw slab window: on-device translation
+        fused with the admission gate + merge + choice draws.  The
+        caller must pre-resolve first-sight keys (mirror.ensure) —
+        unresolved misses raise, because silently dropping them would
+        change the admitted set."""
+        win, counts, call_ids = self._slab_fit(win, counts, call_ids)
+        skeys, svals, meta, dc, ov = self._mirror_ops(mirror)
+        n_in = int(call_ids.shape[0])
+        prev = jnp.asarray(choice_prev, jnp.int32)
+        if self.corpus_len + n_in > self.cap:
+            # matrix cannot take the whole batch: gate-only verdicts,
+            # nothing merges (the serial drop-the-input semantics)
+            new, has_new, _bm, _idx, miss_rows = self._ingest_diff_fn(
+                self.corpus_cover, self.flakes, win, counts, call_ids,
+                skeys, svals, meta, dc, ov)
+            if bool(np.asarray(miss_rows).any()):
+                raise ValueError("admit_slabs: unresolved first-sight "
+                                 "keys (call mirror.ensure first)")
+            choices = self.sample_next_calls(np.asarray(prev))
+            out = (np.asarray(has_new), None, choices,
+                   np.asarray(self._popcount_fn(new)))
+            return out if with_new_bits else out[:3]
+        svec, hinc = self._ts_in()
+        (self.corpus_cover, self.corpus_mat, has_new, nbits, choices,
+         miss_rows, svec) = self._ingest_admit_fn(
+            self.corpus_cover, self.corpus_mat, self.flakes, win, counts,
+            call_ids, jnp.int32(self.corpus_len), self._next_key(),
+            self.prios, self.enabled, prev, skeys, svals, meta, svec,
+            dc, ov, hinc)
+        self._ts_out(svec)
+        has_new = np.asarray(has_new)
+        if bool(np.asarray(miss_rows).any()):
+            raise ValueError("admit_slabs: unresolved first-sight keys "
+                             "(call mirror.ensure first)")
+        admitted = np.nonzero(has_new)[0]
+        rows = np.arange(self.corpus_len, self.corpus_len + len(admitted))
+        self.corpus_call[rows] = np.asarray(call_ids)[admitted]
+        self.corpus_len += len(admitted)
+        choices = np.asarray(choices)
+        if with_new_bits:
+            return has_new, rows, choices, np.asarray(nbits)
+        return has_new, rows, choices
+
+    def triage_diff_slabs(self, win, counts, call_ids, mirror):
+        """Slab-path triage gate: translate + diff vs corpus cover
+        minus flakes, dispatch under the state lock, sync outside.
+        Returns (has_new, new bitmaps, exec bitmaps, per-PC index rows,
+        miss_rows)."""
+        win, counts, call_ids = self._slab_fit(win, counts, call_ids)
+        skeys, svals, meta, dc, ov = self._mirror_ops(mirror)
+        with self._state_mu:
+            new, has_new, bitmaps, idx, miss_rows = self._ingest_diff_fn(
+                self.corpus_cover, self.flakes, win, counts, call_ids,
+                skeys, svals, meta, dc, ov)
+        return np.asarray(has_new), new, bitmaps, idx, miss_rows
+
+    def pack_slabs(self, win, counts, mirror) -> jax.Array:
+        """(B, K) slab window → (B, W) device bitmaps via on-device
+        translation (no state)."""
+        win, counts = self._slab_fit(win, counts)
+        skeys, svals, meta, dc, ov = self._mirror_ops(mirror)
+        return self._ingest_pack_fn(win, counts, skeys, svals, meta,
+                                    dc, ov)
+
+    def pack_or_slabs(self, win, counts, mirror) -> jax.Array:
+        """Slab window → one (1, W) OR-folded bitmap (corpus-merge
+        rows compose bitwise)."""
+        win, counts = self._slab_fit(win, counts)
+        skeys, svals, meta, dc, ov = self._mirror_ops(mirror)
+        return self._ingest_pack_or_fn(win, counts, skeys, svals, meta,
+                                       dc, ov)
 
     def pack_or_rows(self, pc_idx, valid, rowmask) -> jax.Array:
         """Pack rows and OR-fold the selected ones into one (1, W)

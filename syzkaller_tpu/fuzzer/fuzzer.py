@@ -50,6 +50,162 @@ class TriageItem:
     minimized: bool = False
 
 
+def _exec_call_ids(p: M.Prog) -> np.ndarray:
+    """Table call ids per program call, cached on the prog (computed
+    once per executed program — executed progs are immutable, and the
+    slab→call-id mapping must not rebuild Python lists per exec)."""
+    ids = getattr(p, "_exec_ids", None)
+    if ids is None or len(ids) != len(p.calls):
+        ids = np.fromiter((c.meta.id for c in p.calls), np.int32,
+                          len(p.calls))
+        try:
+            p._exec_ids = ids
+        except AttributeError:
+            pass
+    return ids
+
+
+class _RingIngest:
+    """Per-proc zero-copy ingest: the executor's pinned PC ring →
+    fused translate+update device dispatches.
+
+    Per exec the host does O(1) work: one header read to watermark the
+    exec's slab span (`note_exec`).  `flush` turns committed slab runs
+    into zero-copy (B, K) window views, maps each slab to its source
+    program with one vectorized searchsorted over the watermarks,
+    submits the fused dispatch WITHOUT a sync, and resolves the
+    previous batch — the submit/resolve pipeline of the legacy path,
+    minus all its per-exec Python list packing.  Covers materialize
+    host-side ONLY for slabs that earn a new-signal verdict (the rare
+    triage candidates)."""
+
+    def __init__(self, fuzzer: "Fuzzer", env: "ipc.Env"):
+        self.f = fuzzer
+        self.env = env
+        self.reader = env.ring_reader
+        # (prog | None, cached call-id vector, resv watermark): a slab
+        # with global index < watermark belongs to the LAST exec whose
+        # watermark exceeds it; None progs (triage/minimize/candidate
+        # re-executions) discard their slabs
+        self._marks: deque = deque()
+        self._inflight = None
+        self._last_force = time.monotonic()
+        self._last_dropped = 0
+
+    def note_exec(self, prog: "M.Prog | None") -> None:
+        from syzkaller_tpu.ipc import ring as ring_mod
+        ids = _exec_call_ids(prog) if prog is not None else None
+        self._marks.append(
+            (prog, ids, self.reader.ring.load(ring_mod.H_RESV)))
+
+    def on_restart(self) -> None:
+        """The executor died (hang/kill/retry): drain the committed
+        slabs it did land, resolve what's in flight, then skip any torn
+        slab it left reserved-uncommitted — counted, never crashed."""
+        self.maybe_flush(force=True)
+        skipped = self.reader.resync()
+        if skipped and self.f.signal.tstats is not None:
+            self.f.signal.tstats.inc("ingest_resync", skipped)
+
+    def pending(self) -> int:
+        return self.reader.pending()
+
+    def maybe_flush(self, force: bool = False) -> None:
+        now = time.monotonic()
+        if not force and now - self._last_force > 2.0:
+            force = True        # low-throughput runs must not strand slabs
+        if force:
+            self._last_force = now
+        sig = self.f.signal
+        while self.pending() >= (1 if force else sig.B):
+            batch = self.reader.read_batch(max_slabs=max(sig.B, 1))
+            if batch is None:
+                break
+            self._submit(batch)
+        if force:
+            self._resolve(self._take_inflight())
+        self._count_drops()
+
+    def _count_drops(self) -> None:
+        from syzkaller_tpu.ipc import ring as ring_mod
+        dropped = self.reader.ring.load(ring_mod.H_DROPPED)
+        if dropped > self._last_dropped and self.f.signal.tstats is not None:
+            self.f.signal.tstats.inc("ingest_ring_full",
+                                     dropped - self._last_dropped)
+        self._last_dropped = dropped
+
+    def _take_inflight(self):
+        prev, self._inflight = self._inflight, None
+        return prev
+
+    def _submit(self, batch) -> None:
+        # vectorized slab→exec attribution: one searchsorted over the
+        # live watermarks, then call ids through the concatenated
+        # per-prog id vectors (cached on each prog)
+        marks = self._marks
+        W = np.fromiter((m[2] for m in marks), np.int64, len(marks))
+        idsets = [m[1] if m[1] is not None else _EMPTY_IDS for m in marks]
+        lens = np.fromiter((len(x) for x in idsets), np.int64,
+                           len(idsets))
+        base = np.concatenate([[0], np.cumsum(lens)[:-1]]) \
+            if len(idsets) else np.zeros(1, np.int64)
+        cat = (np.concatenate(idsets) if len(idsets)
+               else _EMPTY_IDS)
+        slab_idx = batch.start_idx + np.arange(batch.n, dtype=np.int64)
+        j = np.searchsorted(W, slab_idx, side="right")
+        # slabs past every watermark (mid-exec read) wait for their
+        # exec's note; slabs from discarded execs mask to no-ops
+        live = j < len(marks)
+        tags = batch.tags.astype(np.int64)
+        ok = live & (tags < lens[np.minimum(j, max(len(marks) - 1, 0))])
+        call_ids = np.zeros((batch.n,), np.int32)
+        if ok.any():
+            call_ids[ok] = cat[base[j[ok]] + tags[ok]]
+        counts = np.where(ok, batch.counts, 0).astype(np.int32)
+        if not ok.any():
+            # a batch of discarded slabs only: nothing to dispatch —
+            # resolve what's in flight so this batch can be consumed
+            # in order, then release it
+            self._resolve(self._take_inflight())
+            self.reader.consume(batch)
+            done = batch.start_idx + batch.n
+            while self._marks and self._marks[0][2] <= done:
+                self._marks.popleft()
+            return
+        ticket = self.f.signal.submit_slabs(batch.win, counts, call_ids)
+        owners = [marks[int(jj)][0] if o else None
+                  for jj, o in zip(j, ok)]
+        prev = self._inflight
+        self._inflight = (batch, ticket, owners)
+        self._resolve(prev)
+
+    def _resolve(self, inflight) -> None:
+        if inflight is None:
+            return
+        batch, ticket, owners = inflight
+        has_new = self.f.signal.resolve(ticket)
+        items = []
+        for i in np.nonzero(has_new[: batch.n])[0]:
+            # cover materializes ONLY for new-signal slabs — the rare
+            # path that feeds the triage queue
+            if owners[i] is not None:
+                items.append(TriageItem(
+                    prog=M.clone_prog(owners[i]),
+                    call_index=int(batch.tags[i]),
+                    cover=batch.cover(i)))
+        self.reader.consume(batch)
+        # prune watermarks everything before the batch end has passed
+        done = batch.start_idx + batch.n
+        while self._marks and self._marks[0][2] <= done:
+            self._marks.popleft()
+        if items:
+            with self.f._mu:
+                self.f.triage_q.extend(items)
+
+
+_EMPTY_IDS = np.zeros(0, np.int32)
+
+
 class Fuzzer:
     def __init__(self, name: str, manager_addr: str, procs: int = 1,
                  descriptions: str = "all", flags: "int | None" = None,
@@ -137,6 +293,9 @@ class Fuzzer:
         self._sig_mu = threading.Lock()          # submit-order pipeline
         self._inflight_sig: "tuple | None" = None
         self._corpus_rows: deque[int] = deque()  # device-drawn mutate picks
+        # per-env zero-copy ring ingests (keyed by env identity; each
+        # proc owns one env + one ring)
+        self._ingests: dict[int, _RingIngest] = {}
 
         n = self.table.count
         self.max_cover: list[np.ndarray] = [np.zeros(0, np.uint32)] * n
@@ -314,22 +473,40 @@ class Fuzzer:
                              f"{P.serialize(p).decode()}\n")
             sys.stdout.flush()
 
-    def execute(self, env: ipc.Env, p: M.Prog, stat: str,
-                pid: int) -> "ipc.ExecResult | None":
+    def execute(self, env: ipc.Env, p: M.Prog, stat: str, pid: int,
+                ring_prog: "M.Prog | None" = None
+                ) -> "ipc.ExecResult | None":
+        """ring_prog non-None marks a HOT-loop exec whose covers flow
+        through the zero-copy ring (shm-out cover copies skipped);
+        triage/minimize/candidate re-executions keep parsed covers and
+        their ring slabs are discarded at ingest."""
         self.log_program(pid, p)
         self._stat_counters["exec total"].inc()
         self._stat_counters[stat].inc()
+        ingest = self._ingests.get(id(env))
+        hot = ring_prog is not None and ingest is not None
         for attempt in range(3):
             try:
                 t0 = time.monotonic()
-                res = env.exec(p)
+                res = env.exec(p, parse_covers=not hot,
+                               extra_flags=0 if hot else (
+                                   ipc.FLAG_RING_SKIP
+                                   if ingest is not None else 0))
                 dt = time.monotonic() - t0
                 self._h_exec.observe(dt)
                 if self.signal is not None and self.signal.tstats is not None:
                     self.signal.tstats.observe("exec_latency", dt)
+                if ingest is not None:
+                    if hot:
+                        ingest.note_exec(ring_prog)
+                    if res.restarted or res.hanged:
+                        ingest.on_restart()
                 return res
             except ipc.ExecutorFailure as e:
                 log.logf(0, "executor failure (try %d): %s", attempt, e)
+                if ingest is not None:
+                    ingest.note_exec(None)
+                    ingest.on_restart()
                 time.sleep(0.5 * (attempt + 1))
         return None
 
@@ -549,7 +726,18 @@ class Fuzzer:
             # (SURVEY §7 batching economics) — the pool auto-refills
             # mid-draw, so no per-iteration exhausted() polling
             rand.attach_source(self.ct.take_entropy, 1 << 13)
-        env = ipc.Env(flags=self.flags, pid=pid)
+        # zero-copy ingest: the executor writes raw PC slabs into a
+        # pinned ring; the proc loop's per-exec host work collapses to
+        # one watermark note — translation, packing and diffing all
+        # ride fused device dispatches (narrow-bitmap configs only:
+        # the word-block-sparse path needs host-computed blocks)
+        use_ring = (self.signal is not None
+                    and getattr(self.signal, "_slab_hot_path", False))
+        env = ipc.Env(flags=self.flags, pid=pid, ring=use_ring)
+        ingest = None
+        if use_ring and env.ring is not None:
+            ingest = _RingIngest(self, env)
+            self._ingests[id(env)] = ingest
         gate = self.gate
         try:
             while not self._stop:
@@ -563,9 +751,13 @@ class Fuzzer:
                 if item is not None:
                     with gate.section():
                         self.triage(env, item, rand, pid)
+                    if ingest is not None:
+                        ingest.maybe_flush()   # keep draining mid-triage
                     continue
                 if candidate is not None:
                     self.run_candidate(env, candidate, rand, pid)
+                    if ingest is not None:
+                        ingest.maybe_flush()
                     continue
                 with self._mu:
                     corpus = list(self.corpus)
@@ -591,10 +783,18 @@ class Fuzzer:
                         p = self.generate_seeded(rand, choice)
                     stat = "exec gen"
                 with gate.section():
-                    res = self.execute(env, p, stat, pid)
-                if res is not None:
+                    res = self.execute(env, p, stat, pid,
+                                       ring_prog=p if ingest else None)
+                if ingest is not None:
+                    ingest.maybe_flush()
+                elif res is not None:
                     self.check_new_signal(p, res)
         finally:
+            if ingest is not None:
+                try:
+                    ingest.maybe_flush(force=True)
+                finally:
+                    self._ingests.pop(id(env), None)
             env.close()
 
     def _pick_corpus_row(self, ncorpus: int, rand: P.Rand) -> int:

@@ -54,6 +54,10 @@ class PcMap:
         self._vals = np.zeros(size, np.int32)
         self._rev = np.zeros(self.direct_cap, np.uint64)  # idx -> PC
         self._n = 0
+        # bumped on every first-sight insertion batch: device mirrors
+        # (DeviceKeyMirror) compare against it to know when their
+        # sorted key snapshot is stale
+        self.version = 0
 
     def __len__(self) -> int:
         return self._n
@@ -87,6 +91,7 @@ class PcMap:
         vals = np.arange(self._n, self._n + n, dtype=np.int32)
         self._rev[self._n:self._n + n] = keys
         self._n += n
+        self.version += 1
         h = _mix(keys)
         pend = np.arange(n)
         r = np.zeros(n, np.uint64)
@@ -242,6 +247,102 @@ class PcMap:
             return np.zeros((0, K), np.int32), np.zeros((0, K), bool)
         idx, valid, _owner = self.map_rows(covers, K)
         return idx, valid
+
+
+class DeviceKeyMirror:
+    """Device-resident sorted mirror of a PcMap's direct-mapped keys —
+    the sparse→dense translation table the ingest kernels binary-search
+    on device (cover/engine.py translate_slab_rows), retiring the
+    per-batch host `_lookup`/scatter/dedup/pad packing.
+
+    Layout: two fixed-capacity device arrays (capacity = direct_cap, so
+    incremental appends never change a dispatch signature):
+      skeys (D,) uint32  sorted live keys, 0xFFFFFFFF sentinel padding
+      svals (D,) int32   dense index of skeys[i] (first-seen order ids)
+    plus a tiny (2,) int32 meta operand [n_live_keys, table_full].
+
+    Only keys that fit uint32 are mirrored: slab PCs arrive as u32 (the
+    executor's wire format), and a 64-bit preseeded vmlinux key can
+    never equal a u32 probe — excluding it changes no lookup result.
+    When the direct table is full, the kernel computes the stateless
+    hashed-overflow index itself (same formula as `_map_flat_locked`),
+    so a saturated map never round-trips through the host.  A probe
+    missing while the table still has room IS a new key: the ingest
+    caller resolves those host-side once per batch (PcMap.map_flat on
+    the missed rows — exact first-seen order, so `export_keys` and the
+    PR 9 snapshots stay bit-exact) and `refresh()`es the mirror.
+
+    Thread-safe; `put` is the engine's put_replicated so the arrays
+    live on the engine's device/mesh.  `invalidate()` drops the cached
+    device arrays (backend failover re-homes them on next use)."""
+
+    def __init__(self, pcmap: PcMap, put=None):
+        self.pcmap = pcmap
+        self._put = put
+        self._mu = threading.Lock()
+        self._version = -1
+        self._skeys = None
+        self._svals = None
+        self._meta = None
+        self.stat_refreshes = 0
+
+    def _put_fn(self):
+        if self._put is not None:
+            return self._put
+        import jax.numpy as jnp
+        return jnp.asarray
+
+    def invalidate(self) -> None:
+        with self._mu:
+            self._version = -1
+            self._skeys = self._svals = self._meta = None
+
+    def refresh(self) -> None:
+        """Rebuild the sorted device snapshot if the map grew."""
+        pm = self.pcmap
+        with self._mu:
+            if self._version == pm.version and self._skeys is not None:
+                return
+            with pm._mu:
+                ver = pm.version
+                rev = pm._rev[: pm._n].copy()
+                full = pm._n >= pm.direct_cap
+            D = pm.direct_cap
+            m = rev < np.uint64(1) << np.uint64(32)
+            keys = rev[m].astype(np.uint32)
+            vals = np.nonzero(m)[0].astype(np.int32)
+            order = np.argsort(keys, kind="stable")
+            skeys = np.full((D,), 0xFFFFFFFF, np.uint32)
+            svals = np.zeros((D,), np.int32)
+            skeys[: len(keys)] = keys[order]
+            svals[: len(keys)] = vals[order]
+            put = self._put_fn()
+            self._skeys = put(skeys)
+            self._svals = put(svals)
+            self._meta = put(np.array([len(keys), int(full)], np.int32))
+            self._version = ver
+            self.stat_refreshes += 1
+
+    def operands(self):
+        """(skeys, svals, meta) device operands for a translate kernel
+        dispatch, refreshed if stale."""
+        self.refresh()
+        with self._mu:
+            return self._skeys, self._svals, self._meta
+
+    def ensure(self, pcs) -> int:
+        """Insert any first-sight keys in `pcs` (occurrence order — the
+        exact host `map_flat` semantics, overflow-hit counting included)
+        and refresh the mirror if that grew the map.  Returns the
+        number of keys added.  This is the admission-path pre-resolve:
+        after it, a translate dispatch over `pcs` cannot miss."""
+        pm = self.pcmap
+        before = len(pm)
+        pm.map_flat(np.asarray(pcs, np.uint64))
+        added = len(pm) - before
+        if added or self._version != pm.version:
+            self.refresh()
+        return added
 
 
 def _dedup_rows(idx: np.ndarray, valid: np.ndarray) -> None:

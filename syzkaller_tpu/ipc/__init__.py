@@ -2,7 +2,10 @@
 
 from syzkaller_tpu.ipc.env import (  # noqa: F401
     FLAG_COLLIDE, FLAG_COVER, FLAG_DEBUG, FLAG_DEDUP_COVER, FLAG_ENABLE_TUN,
-    FLAG_FAKE_COVER, FLAG_SANDBOX_NAMESPACE, FLAG_SANDBOX_SETUID,
-    FLAG_THREADED,
+    FLAG_FAKE_COVER, FLAG_RING_SKIP, FLAG_SANDBOX_NAMESPACE,
+    FLAG_SANDBOX_SETUID, FLAG_THREADED,
     CallResult, Env, ExecResult, ExecutorFailure, Gate,
+)
+from syzkaller_tpu.ipc.ring import (  # noqa: F401
+    PcRing, RingReader, RingWriter, SlabBatch,
 )

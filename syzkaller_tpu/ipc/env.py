@@ -39,6 +39,7 @@ FLAG_SANDBOX_SETUID = 1 << 5
 FLAG_SANDBOX_NAMESPACE = 1 << 6
 FLAG_FAKE_COVER = 1 << 7
 FLAG_ENABLE_TUN = 1 << 8
+FLAG_RING_SKIP = 1 << 9   # don't write this exec's covers to the PC ring
 
 # executor exit statuses (ref common.h:46-48)
 STATUS_OK = 0
@@ -49,6 +50,9 @@ STATUS_RETRY = 69    # transient -> relaunch env
 
 class ExecutorFailure(Exception):
     """The executor itself misbehaved (protocol/logic error, status 67)."""
+
+
+_EMPTY_COVER = np.zeros(0, np.uint32)   # shared sentinel: covers skipped
 
 
 @dataclass
@@ -81,7 +85,8 @@ class Env:
 
     def __init__(self, flags: int = FLAG_COVER | FLAG_DEDUP_COVER,
                  pid: int = 0, executor: "str | None" = None,
-                 workdir: "str | None" = None, timeout: float = 10.0):
+                 workdir: "str | None" = None, timeout: float = 10.0,
+                 ring: bool = False):
         self.flags = flags
         self.pid = pid
         self.timeout = timeout
@@ -95,6 +100,22 @@ class Env:
         self._out_mm = None
         self.stat_execs = 0
         self.stat_restarts = 0
+        # zero-copy PC slab ring: the executor writes raw covers into a
+        # third shm region (ipc/ring.py layout) and the ingest side
+        # consumes batched zero-copy views — no per-call frombuffer
+        # copies on the hot path.  The ring survives executor restarts
+        # (header state lives in the file); after a kill the reader
+        # resyncs past any torn slab.
+        self.ring = None
+        self.ring_reader = None
+        if ring:
+            from syzkaller_tpu.ipc import ring as ring_mod
+            self._ring_file = os.path.join(self.workdir, f"shm-ring-{pid}")
+            # min_bucket=64 quantizes typical covers into ONE bucket so
+            # committed runs (= zero-copy dispatch batches) stay long
+            self.ring = ring_mod.PcRing.create(self._ring_file,
+                                               min_bucket=64)
+            self.ring_reader = ring_mod.RingReader(self.ring)
         self._open_shm()
 
     def _open_shm(self) -> None:
@@ -123,6 +144,8 @@ class Env:
         # fd numbers go via argv: subprocess keeps pass_fds at their
         # original numbers (dup2-in-preexec would be undone by close_fds).
         fds = (self._in_fd, self._out_fd, req_r, rep_w)
+        if self.ring is not None:
+            fds = fds + (self.ring.fd,)
         return subprocess.Popen(
             [self.executor, *map(str, fds)],
             pass_fds=fds,
@@ -166,12 +189,32 @@ class Env:
                 os.close(fd)
             except OSError:
                 pass
+        if self.ring is not None:
+            self.ring.close()
+
+    def ring_resync(self) -> int:
+        """Skip any torn (reserved-uncommitted) slab the executor left
+        behind when it was killed mid-slab-write.  Only valid after the
+        executor process is down (exec() kills before relaunch)."""
+        if self.ring_reader is None:
+            return 0
+        return self.ring_reader.resync()
 
     # -- execution ---------------------------------------------------------
 
-    def exec(self, p: "M.Prog | bytes") -> ExecResult:
+    def exec(self, p: "M.Prog | bytes", parse_covers: bool = True,
+             extra_flags: int = 0) -> ExecResult:
         """Run one program; relaunches the executor transparently on
-        hang/retryable failure (ref ipc.go:206-218)."""
+        hang/retryable failure (ref ipc.go:206-218).
+
+        parse_covers=False skips the per-call cover `frombuffer().copy()`
+        from shm-out (errno/index records are still parsed) — the ring
+        ingest path reads covers as zero-copy slab views instead, so
+        copying them here would pay the host packing twice.
+        extra_flags ORs per-exec flag bits into the request header
+        (FLAG_RING_SKIP keeps triage/minimize re-executions out of the
+        slab ring, so hot-loop attribution stays 1:1)."""
+        self._parse_covers = parse_covers
         data = p if isinstance(p, bytes) else serialize_for_exec(p, self.pid)
         res = ExecResult()
         if self._proc is None or self._proc.poll() is not None:
@@ -180,7 +223,8 @@ class Env:
             res.restarted = True
             self.stat_restarts += 1
 
-        header = struct.pack("<QQQ", self.flags, self.pid, len(data) // 8)
+        header = struct.pack("<QQQ", self.flags | extra_flags, self.pid,
+                             len(data) // 8)
         if len(header) + len(data) > IN_SHM_SIZE:
             raise ExecutorFailure(
                 f"program exec image too large for shm-in: "
@@ -248,8 +292,11 @@ class Env:
             pos += 16
             if ncov > (len(buf) - pos) // 4:
                 break
-            cover = np.frombuffer(buf, dtype=np.uint32, count=ncov,
-                                  offset=pos).copy()
+            if getattr(self, "_parse_covers", True):
+                cover = np.frombuffer(buf, dtype=np.uint32, count=ncov,
+                                      offset=pos).copy()
+            else:
+                cover = _EMPTY_COVER
             pos += ncov * 4
             res.calls.append(CallResult(index=idx, errno=err, cover=cover))
         buf.release()

@@ -1,0 +1,365 @@
+"""Pinned PC slab ring: the zero-copy executor→device ingest transport.
+
+The executor→engine path used to cross the host boundary per exec:
+KCOV PCs traveled shm → per-call `np.frombuffer().copy()` → Python
+lists → PcMap hash lookups → numpy padding → device transfer — which is
+why device replay lost to the CPU path outright (BENCH_r02: 4.3k/s
+device vs 17.7k/s CPU).  This module is the transport half of the fix:
+a shared-memory ring the executor (native/executor.cc mirrors this
+layout word for word) writes raw fixed-layout PC slabs into, and the
+ingest side reads back as zero-copy numpy views shaped for direct
+device dispatch — no per-exec host packing, no Python list
+materialization.
+
+Wire layout (all little-endian, one file):
+
+    header (128 bytes):
+        u64 magic     'SYZRING1'
+        u32 version   (1)
+        u32 slab_cap  max PCs per slab (longer covers truncate, like the
+                      reference's per-call KCOV cap)
+        u64 index_slots, u64 data_words
+        u64 resv_idx     [writer] slabs reserved, monotonic
+        u64 head_words   [writer] data words reserved, monotonic
+        u64 consumed_idx [reader] slabs consumed, monotonic
+        u64 tail_words   [reader] data words consumed, monotonic
+        u64 dropped_full [writer] slabs dropped: ring full
+        u64 wasted_words [writer] wrap padding burned
+        u64 skipped_uncommitted [reader] torn slabs skipped on resync
+    index ring: index_slots × 16-byte records
+        u32 commit, u32 tag (call index/id), u32 npcs, u32 off_words
+    data ring:  data_words × u32 raw PCs
+
+Slab sizes are pow2-bucketed (min 8 words): a run of same-bucket slabs
+is perfectly contiguous in the data ring, so a whole batch reshapes to
+a (B, bucket) numpy VIEW — the device transfer consumes it directly
+(dlpack/zero-copy on CPU, one DMA elsewhere) with no gather and no
+padding copy.
+
+Commit protocol (seqlock-style, single writer):
+
+    1. store commit=0 + {tag, npcs, off} into the index record
+    2. release-store resv_idx+1, head_words+bucket  (reservation visible)
+    3. write the PC payload into the data ring
+    4. release-store commit=1
+
+A reader never sees a torn slab: it consumes only the committed prefix.
+A writer SIGKILLed between (2) and (4) leaves one reserved-uncommitted
+slab; `RingReader.resync()` skips it BY ITS LENGTH PREFIX (the npcs
+field landed before the reservation was published), counts it in
+`skipped_uncommitted`, and the ring keeps flowing — crash-only, like
+the rest of the plane.  Ring-full is a counted drop (`dropped_full`),
+never a blocked executor.
+"""
+
+from __future__ import annotations
+
+import mmap
+import os
+import struct
+import time
+
+import numpy as np
+
+MAGIC = 0x53595A52494E4731        # 'SYZRING1' (little-endian bytes)
+VERSION = 1
+HDR_SIZE = 128
+REC_WORDS = 4                     # index record size in u32 words
+MIN_BUCKET = 8                    # smallest slab allocation, words
+
+# header u64-slot indices (the header is viewed as 16 uint64 words;
+# version/slab_cap share slot 1 as two u32 halves)
+H_MAGIC, H_VER_CAP, H_INDEX_SLOTS, H_DATA_WORDS = 0, 1, 2, 3
+H_RESV, H_HEAD, H_CONSUMED, H_TAIL = 4, 5, 6, 7
+H_DROPPED, H_WASTED, H_SKIPPED, H_MIN_BUCKET = 8, 9, 10, 11
+
+DEFAULT_DATA_WORDS = 1 << 20      # 4MB of raw PCs
+DEFAULT_INDEX_SLOTS = 1 << 13
+DEFAULT_SLAB_CAP = 512
+
+
+def bucket_words(n: int, cap: int, min_bucket: int = MIN_BUCKET) -> int:
+    """Pow2 slab allocation bucket for an n-PC cover (n clipped to cap).
+
+    `min_bucket` quantizes small slabs up to one common bucket: mixed
+    real-world cover sizes would otherwise fragment the ring into short
+    same-bucket runs, and a run IS the zero-copy dispatch batch — a
+    few padding words per slab buys full-width fused dispatches."""
+    n = min(int(n), cap)
+    b = max(MIN_BUCKET, int(min_bucket) or MIN_BUCKET)
+    while b < n:
+        b <<= 1
+    return b
+
+
+class PcRing:
+    """One mapped ring file: header + index ring + data ring views.
+
+    `create` initializes a fresh file (the Python side always owns
+    initialization — the executor only ever attaches); `attach` maps an
+    existing one.  All numpy views alias the mmap, so header mutations
+    are immediately visible across processes (same coherence contract
+    as the existing shm-out count word)."""
+
+    def __init__(self, path: str, mm: mmap.mmap, fd: int):
+        self.path = path
+        self.mm = mm
+        self.fd = fd
+        hdr = np.frombuffer(mm, np.uint64, count=HDR_SIZE // 8, offset=0)
+        if int(hdr[H_MAGIC]) != MAGIC:
+            raise ValueError(f"{path}: bad ring magic")
+        self.hdr = hdr
+        ver_cap = int(hdr[H_VER_CAP])
+        self.version = ver_cap & 0xFFFFFFFF
+        self.slab_cap = ver_cap >> 32
+        self.index_slots = int(hdr[H_INDEX_SLOTS])
+        self.data_words = int(hdr[H_DATA_WORDS])
+        self.min_bucket = max(MIN_BUCKET, int(hdr[H_MIN_BUCKET]))
+        self.index = np.frombuffer(
+            mm, np.uint32, count=self.index_slots * REC_WORDS,
+            offset=HDR_SIZE).reshape(self.index_slots, REC_WORDS)
+        self.data = np.frombuffer(
+            mm, np.uint32, count=self.data_words,
+            offset=HDR_SIZE + self.index_slots * REC_WORDS * 4)
+
+    @staticmethod
+    def file_size(data_words: int, index_slots: int) -> int:
+        return HDR_SIZE + index_slots * REC_WORDS * 4 + data_words * 4
+
+    @classmethod
+    def create(cls, path: str, data_words: int = DEFAULT_DATA_WORDS,
+               index_slots: int = DEFAULT_INDEX_SLOTS,
+               slab_cap: int = DEFAULT_SLAB_CAP,
+               min_bucket: int = MIN_BUCKET) -> "PcRing":
+        size = cls.file_size(data_words, index_slots)
+        with open(path, "wb") as f:
+            f.truncate(size)
+        fd = os.open(path, os.O_RDWR)
+        mm = mmap.mmap(fd, size)
+        struct.pack_into("<Q", mm, 0, MAGIC)
+        # version/slab_cap packed as one u64 slot: low u32 version,
+        # high u32 slab cap
+        struct.pack_into("<Q", mm, 8, VERSION | (slab_cap << 32))
+        struct.pack_into("<QQ", mm, 16, index_slots, data_words)
+        struct.pack_into("<Q", mm, H_MIN_BUCKET * 8, min_bucket)
+        return cls(path, mm, fd)
+
+    @classmethod
+    def attach(cls, path: str) -> "PcRing":
+        fd = os.open(path, os.O_RDWR)
+        size = os.fstat(fd).st_size
+        mm = mmap.mmap(fd, size)
+        return cls(path, mm, fd)
+
+    def close(self) -> None:
+        try:
+            self.mm.close()
+        except (BufferError, ValueError):
+            pass                    # live views keep the map alive
+        try:
+            os.close(self.fd)
+        except OSError:
+            pass
+
+    # -- header accessors (u64 loads/stores through the shared map) -------
+
+    def load(self, slot: int) -> int:
+        return int(self.hdr[slot])
+
+    def store(self, slot: int, val: int) -> None:
+        self.hdr[slot] = np.uint64(val)
+
+    def stats(self) -> dict:
+        return {"resv_idx": self.load(H_RESV),
+                "consumed_idx": self.load(H_CONSUMED),
+                "head_words": self.load(H_HEAD),
+                "tail_words": self.load(H_TAIL),
+                "dropped_full": self.load(H_DROPPED),
+                "wasted_words": self.load(H_WASTED),
+                "skipped_uncommitted": self.load(H_SKIPPED)}
+
+
+class RingWriter:
+    """Reference Python writer — the protocol twin of the executor's
+    `ring_write` (native/executor.cc).  Production slabs come from the
+    native side; this one feeds tests, bench replay, and the chaos
+    harness.  `pause_before_commit` freezes a write between reservation
+    and commit so the chaos harness can SIGKILL a writer mid-slab and
+    prove the reader resyncs."""
+
+    def __init__(self, ring: PcRing, pause_before_commit: bool = False):
+        self.ring = ring
+        self.pause_before_commit = pause_before_commit
+        self.stat_written = 0
+
+    def write(self, tag: int, pcs: np.ndarray) -> bool:
+        """Append one slab; False = dropped (ring full)."""
+        r = self.ring
+        pcs = np.asarray(pcs, np.uint32).ravel()[: r.slab_cap]
+        n = len(pcs)
+        if n == 0:
+            return True
+        bucket = bucket_words(n, r.slab_cap, r.min_bucket)
+        resv = r.load(H_RESV)
+        if resv - r.load(H_CONSUMED) >= r.index_slots:
+            r.store(H_DROPPED, r.load(H_DROPPED) + 1)
+            return False
+        head, tail, dw = r.load(H_HEAD), r.load(H_TAIL), r.data_words
+        rem = dw - head % dw
+        skip = rem if bucket > rem else 0
+        if head + skip + bucket - tail > dw:
+            r.store(H_DROPPED, r.load(H_DROPPED) + 1)
+            return False
+        off = (head + skip) % dw
+        rec = r.index[resv % r.index_slots]
+        rec[0] = 0                              # commit=0 first
+        rec[1] = np.uint32(tag)
+        rec[2] = np.uint32(n)
+        rec[3] = np.uint32(off)
+        r.store(H_WASTED, r.load(H_WASTED) + skip)
+        r.store(H_HEAD, head + skip + bucket)
+        r.store(H_RESV, resv + 1)               # reservation visible
+        if self.pause_before_commit:
+            # chaos hook: the slab is reserved but the payload/commit
+            # never lands — the parent SIGKILLs us here
+            while True:
+                time.sleep(0.05)
+        r.data[off: off + n] = pcs
+        rec[0] = 1                              # commit
+        self.stat_written += 1
+        return True
+
+
+class SlabBatch:
+    """One bucket-homogeneous committed run, as zero-copy views.
+
+    `win` is a (n, bucket) uint32 VIEW over the data ring (row i's live
+    prefix is `win[i, :counts[i]]`), safe to read until `consume()` —
+    the writer cannot reuse the region before tail_words advances."""
+
+    __slots__ = ("win", "counts", "tags", "start_idx", "n", "bucket")
+
+    def __init__(self, win, counts, tags, start_idx, n, bucket):
+        self.win = win
+        self.counts = counts
+        self.tags = tags
+        self.start_idx = start_idx
+        self.n = n
+        self.bucket = bucket
+
+    def cover(self, i: int) -> np.ndarray:
+        """Materialize one slab's PCs (rare paths only — triage items)."""
+        return np.array(self.win[i, : self.counts[i]], np.uint32)
+
+
+class RingReader:
+    """Batched consumer.  `read_batch` returns the largest power-of-two
+    prefix of the committed same-bucket run (so dispatch shapes stay in
+    the pow2 × pow2 closed set and the window is a contiguous reshape);
+    the read cursor runs ahead of consumption so batches pipeline —
+    `consume()` (after the device is done with the view) is what frees
+    the region for the writer."""
+
+    def __init__(self, ring: PcRing):
+        self.ring = ring
+        self.read_idx = ring.load(H_CONSUMED)
+        self.stat_batches = 0
+        self.stat_slabs = 0
+
+    def pending(self) -> int:
+        """Slabs reserved but not yet read (committed or not)."""
+        return self.ring.load(H_RESV) - self.read_idx
+
+    def unconsumed(self) -> int:
+        return self.read_idx - self.ring.load(H_CONSUMED)
+
+    def read_batch(self, max_slabs: "int | None" = None
+                   ) -> "SlabBatch | None":
+        r = self.ring
+        resv = r.load(H_RESV)
+        avail = resv - self.read_idx
+        if avail <= 0:
+            return None
+        slot0 = self.read_idx % r.index_slots
+        n = min(avail, r.index_slots - slot0)
+        if max_slabs:
+            n = min(n, max_slabs)
+        recs = r.index[slot0: slot0 + n]
+        commit = recs[:, 0]
+        if not commit.all():
+            n = int(np.argmin(commit != 0))      # committed prefix only
+            if n == 0:
+                return None
+            recs = recs[:n]
+        counts = recs[:, 2].astype(np.int64)
+        # one pow2 bucket per batch: cap the run at the first bucket
+        # change so the window is a dense (n, bucket) reshape
+        buckets = np.maximum(
+            self.ring.min_bucket,
+            (1 << np.ceil(np.log2(np.maximum(counts, 1))).astype(np.int64)))
+        change = np.nonzero(buckets != buckets[0])[0]
+        if len(change):
+            n = int(change[0])
+        # cap at a data-ring wrap inside the run (offset decreases)
+        offs = recs[:n, 3].astype(np.int64)
+        wrap = np.nonzero(np.diff(offs) < 0)[0]
+        if len(wrap):
+            n = int(wrap[0]) + 1
+        # largest pow2 prefix: dispatch batch shapes stay a closed set
+        b = 1
+        while b * 2 <= n:
+            b *= 2
+        n = b
+        bucket = int(buckets[0])
+        off0 = int(offs[0])
+        win = r.data[off0: off0 + n * bucket].reshape(n, bucket)
+        batch = SlabBatch(win=win,
+                          counts=recs[:n, 2].astype(np.int32).copy(),
+                          tags=recs[:n, 1].astype(np.int32).copy(),
+                          start_idx=self.read_idx, n=n, bucket=bucket)
+        self.read_idx += n
+        self.stat_batches += 1
+        self.stat_slabs += n
+        return batch
+
+    def consume(self, batch: SlabBatch) -> None:
+        """Release a batch's region back to the writer.  Batches must be
+        consumed in read order (the pipeline resolves them in order)."""
+        r = self.ring
+        cons = r.load(H_CONSUMED)
+        if batch.start_idx != cons:
+            raise ValueError(
+                f"out-of-order consume: batch {batch.start_idx} != "
+                f"consumed {cons}")
+        tail, dw = r.load(H_TAIL), r.data_words
+        off0 = int(batch.win.ctypes.data
+                   - r.data.ctypes.data) // 4 if batch.n else tail % dw
+        delta = (off0 - tail % dw) % dw          # wrap padding, if any
+        r.store(H_TAIL, tail + delta + batch.n * batch.bucket)
+        r.store(H_CONSUMED, cons + batch.n)
+
+    def resync(self) -> int:
+        """Skip reserved-but-uncommitted slabs at the front (a writer
+        died mid-slab-write).  Only call when the writer is known dead —
+        a live writer commits in bounded time.  Discards any read-ahead
+        (those views may straddle the torn region) and returns how many
+        slabs were skipped (also counted in the shared header)."""
+        r = self.ring
+        self.read_idx = r.load(H_CONSUMED)
+        skipped = 0
+        while r.load(H_RESV) > self.read_idx:
+            rec = r.index[self.read_idx % r.index_slots]
+            if rec[0] != 0:
+                break
+            npcs = int(rec[2])
+            bucket = bucket_words(max(npcs, 1), r.slab_cap, r.min_bucket)
+            tail, dw = r.load(H_TAIL), r.data_words
+            off = int(rec[3])
+            delta = (off - tail % dw) % dw
+            r.store(H_TAIL, tail + delta + bucket)
+            r.store(H_CONSUMED, self.read_idx + 1)
+            self.read_idx += 1
+            skipped += 1
+        if skipped:
+            r.store(H_SKIPPED, r.load(H_SKIPPED) + skipped)
+        return skipped

@@ -244,29 +244,49 @@ class AdmissionCoalescer:
                     fresh.append(p)
             if not fresh:
                 return
-            # ONE vectorized sparse→dense mapping for the whole batch,
-            # padded to pow2-bucketed dispatch shapes: arbitrary shapes
-            # would recompile per batch, while always padding to
-            # (max_batch, K) would make every small batch pay the full
-            # batch's kernel cost — per-step work should follow the
-            # batch's live size instead
+            # zero-copy ingest plane: the sparse→dense translation runs
+            # ON DEVICE inside the fused admission dispatch (binary
+            # search over the PcMap's sorted key mirror) — the host
+            # keeps only a slab pack at pow2-bucketed dispatch shapes
+            # plus ONE vectorized first-sight probe (mirror.ensure,
+            # which IS PcMap.map_flat: steady state is a pure lookup
+            # pass, and new keys insert in exact first-seen order so
+            # export_keys/snapshots stay bit-exact)
             n = len(fresh)
             maxlen = max(min(len(p.cover), self.K) for p in fresh)
             kb = pow2_bucket(maxlen, self.MIN_K, self.K)
-            idx, valid = mgr.pcmap.map_batch([p.cover for p in fresh],
-                                             K=kb)
             B = pow2_bucket(n, self.MIN_B, self.max_batch)
+            win = np.zeros((B, kb), np.uint32)
+            counts = np.zeros((B,), np.int32)
             call_ids = np.zeros((B,), np.int32)
-            pidx = np.zeros((B, kb), np.int32)
-            pval = np.zeros((B, kb), bool)
+            wide = False            # >u32 PCs can't ride the u32 slab wire
+            for i, p in enumerate(fresh):
+                cov = np.asarray(p.cover)[: kb]
+                if len(cov) and int(cov.max()) >> 32:
+                    wide = True
+                    break
+                win[i, : len(cov)] = cov.astype(np.uint32)
+                counts[i] = len(cov)
             call_ids[:n] = [p.call_id for p in fresh]
-            pidx[:n] = idx
-            pval[:n] = valid
             prev = np.full((self.choices_per_step,), -1, np.int32)
             t_disp = time.monotonic()
-            has_new, rows, choices, new_bits = mgr.engine.admit_batch(
-                call_ids, pidx, pval, choice_prev=prev,
-                with_new_bits=True)
+            if wide:
+                # legacy host-mapped path (64-bit preseed-style covers)
+                idx, valid = mgr.pcmap.map_batch(
+                    [p.cover for p in fresh], K=kb)
+                pidx = np.zeros((B, kb), np.int32)
+                pval = np.zeros((B, kb), bool)
+                pidx[:n] = idx
+                pval[:n] = valid
+                has_new, rows, choices, new_bits = mgr.engine.admit_batch(
+                    call_ids, pidx, pval, choice_prev=prev,
+                    with_new_bits=True)
+            else:
+                live = np.arange(kb)[None, :] < counts[:n, None]
+                mgr.pc_mirror.ensure(win[:n][live])
+                has_new, rows, choices, new_bits = mgr.engine.admit_slabs(
+                    win, counts, call_ids, choice_prev=prev,
+                    mirror=mgr.pc_mirror, with_new_bits=True)
             t_done = time.monotonic()
             ds = mgr.device_stats
             if ds is not None:

@@ -124,6 +124,14 @@ class Manager:
         self.engine.set_enabled(
             [self.table.call_map[n].id for n in self.enabled_names])
         self.pcmap = PcMap(cfg.npcs)
+        # device-resident half of the PcMap: the coalescer's fused
+        # admission dispatch translates covers on device against this
+        # sorted key mirror (zero-copy ingest plane); first-sight keys
+        # are resolved host-side before dispatch (exact first-seen
+        # order — snapshots and export_keys stay bit-exact)
+        from syzkaller_tpu.fuzzer.pcmap import DeviceKeyMirror
+        self.pc_mirror = DeviceKeyMirror(self.pcmap,
+                                         put=self.engine.put_replicated)
         # async vmlinux PC-universe scan (ref cover.go:57-69 initAllCover):
         # pre-seeds the PcMap for restart-stable bitmap indices and feeds
         # the /cover line report
@@ -302,6 +310,9 @@ class Manager:
         pre-drawn blocks (they were drawn on the other backend's PRNG
         chain); campaign overlays rebuild through the same epoch path
         so steered Polls keep flowing without a recompile."""
+        # the PcMap mirror's cached key arrays live on the swapped-out
+        # backend: drop them so the next admission re-homes the mirror
+        self.pc_mirror.invalidate()
         self.dstream.rebind()
         with self._camp_mu:
             streams = list(self._campaign_streams.items())

@@ -140,6 +140,11 @@ print("telemetry ok: %d series" % len(series))
             raise SystemExit(f"chaos smoke failed ({r.returncode})")
         out = json.loads(r.stdout.strip().splitlines()[-1])
         assert out["frontier_bit_exact"] and out["corpus_lost"] == 0, out
+        # zero-copy ingest fold-in: the mid-slab-write SIGKILL cycle
+        # must skip the torn slab (counted) and resync the ring
+        ringc = out["ring"]
+        assert ringc["ring_resynced"] and ringc["ring_torn_skipped"] == 1, \
+            ringc
         auto = out["autopilot"]
         assert auto["recovered"] and auto["frontier_bit_exact"] \
             and auto["corpus_lost"] == 0 \
@@ -152,18 +157,33 @@ print("telemetry ok: %d series" % len(series))
     def bench_smoke():
         # seconds-scale CPU-only bench pass on tiny shapes: catches
         # bench.py import/shape regressions here instead of in the next
-        # full bench round (which historically surfaced them as rc=1)
+        # full bench round (which historically surfaced them as rc=1).
+        # Runs with the backend-init probe FORCED to fail: bench must
+        # exit 0 through the CPU fallback with the default backend
+        # unavailable (the BENCH_r05 regression, pinned here)
         import json
 
+        benv = dict(env)
+        benv["SYZ_BENCH_FORCE_BACKEND_FAIL"] = "1"
+        benv.pop("JAX_PLATFORMS", None)
         r = subprocess.run(
             [sys.executable, "bench.py", "--smoke"],
-            cwd=root, env=env, capture_output=True, text=True)
+            cwd=root, env=benv, capture_output=True, text=True)
         if r.returncode != 0:
             sys.stderr.write(r.stderr[-2000:])
             raise SystemExit(f"bench smoke failed ({r.returncode})")
         line = r.stdout.strip().splitlines()[-1]
         out = json.loads(line)            # the JSON line must parse
         assert out["metric"] and out["extras"], out
+        assert out["extras"].get("backend") == "cpu-fallback", \
+            "forced backend failure did not take the CPU fallback"
+        assert out["extras"].get("ingest_dispatches_const"), \
+            "ingest per-exec dispatch count not constant"
+        dev = out["extras"]["replay_execs_per_sec_device"]
+        cpu = out["extras"]["replay_execs_per_sec_cpu"]
+        assert dev >= cpu, \
+            f"zero-copy replay lost to CPU on the same backend: " \
+            f"{dev} < {cpu}"
 
     total = 0.0
     total += step("description tables", gen_tables)

@@ -442,6 +442,108 @@ def run_kill_restore_cycle(base_dir: str, n_inputs: int = 48,
             sigkill(proc)
 
 
+_RING_WRITER_SCRIPT = r"""
+import os, sys, time
+import numpy as np
+sys.path.insert(0, sys.argv[2])
+from syzkaller_tpu.ipc import ring as R
+ring = R.PcRing.attach(sys.argv[1])
+w = R.RingWriter(ring)
+n = int(sys.argv[3])
+for i in range(n):
+    w.write(i, np.arange(100 + i, 100 + i + 12, dtype=np.uint32))
+if len(sys.argv) > 4 and sys.argv[4] == "tear":
+    # reserve one more slab but never commit it: the parent SIGKILLs
+    # us inside the pre-commit pause — the mid-slab-write death
+    sys.stdout.write("TEARING\n")
+    sys.stdout.flush()
+    w.pause_before_commit = True
+    w.write(n, np.arange(5, dtype=np.uint32))
+sys.stdout.write("DONE\n")
+sys.stdout.flush()
+time.sleep(60)
+"""
+
+
+def run_ring_chaos(base_dir: str, n_slabs: int = 24,
+                   verbose: bool = False) -> dict:
+    """Zero-copy ingest fold-in: SIGKILL a ring writer process (the
+    executor's protocol twin) MID-SLAB-WRITE — after it published the
+    reservation but before the commit word — and assert the reader
+    (a) drains every committed slab intact, (b) SKIPS the torn slab by
+    its length prefix, counted not crashed, and (c) the ring resyncs:
+    a fresh writer generation (the relaunched executor) appends slabs
+    the reader consumes normally."""
+    from syzkaller_tpu.ipc import ring as ring_mod
+
+    os.makedirs(base_dir, exist_ok=True)
+    path = os.path.join(base_dir, "chaos-ring")
+    ring = ring_mod.PcRing.create(path, data_words=1 << 12,
+                                  index_slots=256, slab_cap=64)
+    reader = ring_mod.RingReader(ring)
+    out: dict = {}
+
+    def spawn_writer(n, tear):
+        args = [sys.executable, "-c", _RING_WRITER_SCRIPT, path,
+                repo_root(), str(n)] + (["tear"] if tear else [])
+        return subprocess.Popen(args, stdout=subprocess.PIPE, text=True)
+
+    t0 = time.monotonic()
+    w1 = spawn_writer(n_slabs, tear=True)
+    assert w1.stdout.readline().strip() == "TEARING", \
+        "ring chaos writer failed to start"
+    # the torn slab is reserved (resv advanced) but will never commit
+    deadline = time.monotonic() + 30
+    while ring.load(ring_mod.H_RESV) < n_slabs + 1:
+        if time.monotonic() > deadline:
+            raise AssertionError("torn reservation never appeared")
+        time.sleep(0.01)
+    sigkill(w1)
+    w1.wait()
+
+    got = []
+    while True:
+        b = reader.read_batch()
+        if b is None:
+            break
+        for i in range(b.n):
+            got.append((int(b.tags[i]), b.cover(i).copy()))
+        reader.consume(b)
+    assert len(got) == n_slabs, f"committed slabs lost: {len(got)}"
+    for i, (tag, cov) in enumerate(got):
+        assert tag == i and np.array_equal(
+            cov, np.arange(100 + i, 100 + i + 12, dtype=np.uint32)), \
+            f"slab {i} corrupted after writer death"
+    skipped = reader.resync()
+    assert skipped == 1, f"torn slab not skipped (skipped={skipped})"
+    assert ring.load(ring_mod.H_SKIPPED) == 1
+
+    # resync proof: a new writer generation appends; the reader flows
+    w2 = spawn_writer(8, tear=False)
+    assert w2.stdout.readline().strip() == "DONE"
+    more = 0
+    deadline = time.monotonic() + 30
+    while more < 8 and time.monotonic() < deadline:
+        b = reader.read_batch()
+        if b is None:
+            time.sleep(0.01)
+            continue
+        more += b.n
+        reader.consume(b)
+    sigkill(w2)
+    w2.wait()
+    assert more == 8, f"ring did not resync ({more}/8 post-tear slabs)"
+    out["ring_slabs_read"] = len(got) + more
+    out["ring_torn_skipped"] = skipped
+    out["ring_resynced"] = True
+    out["ring_chaos_seconds"] = round(time.monotonic() - t0, 3)
+    if verbose:
+        print(f"[chaos] ring: {len(got)} committed + {more} post-tear "
+              f"slabs intact, {skipped} torn slab skipped", flush=True)
+    ring.close()
+    return out
+
+
 def _admit_direct(mgr, inp, name: str = "serial") -> dict:
     data, call, ci, cover = inp
     from syzkaller_tpu import rpc as rpc_mod

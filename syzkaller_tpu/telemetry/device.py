@@ -63,6 +63,16 @@ SCALAR_SLOTS = [
     ("triage_batches", "syz_triage_dispatches_total", {}),
     ("triage_reports", "syz_triage_reports_total", {}),
     ("triage_edges", "syz_triage_edges_total", {}),
+    # zero-copy ingest plane: slab/byte counts are bumped INSIDE the
+    # fused translate+update dispatch; ring-full drops, resync skips and
+    # host-resolved new keys are host-known events staged through the
+    # pending buffer (the existing zero-extra-transfer path)
+    ("ingest_slabs", "syz_ingest_slabs_total", {}),
+    ("ingest_bytes", "syz_ingest_bytes_total", {}),
+    ("ingest_batches", "syz_ingest_dispatches_total", {}),
+    ("ingest_ring_full", "syz_ingest_ring_full_total", {}),
+    ("ingest_resync", "syz_ingest_resync_skipped_total", {}),
+    ("ingest_new_keys", "syz_ingest_new_keys_total", {}),
 ]
 
 HIST_SLOTS = [
@@ -75,6 +85,9 @@ HIST_SLOTS = [
     # end-to-end latency of one triage dedup batch (featurize +
     # similarity dispatch + label fetch), host-observed
     ("triage_latency", "syz_triage_batch_seconds"),
+    # dispatch→resolved latency of one slab-batch translate+update
+    # through the ingest plane, host-observed
+    ("ingest_translate_latency", "syz_ingest_batch_translate_seconds"),
 ]
 
 

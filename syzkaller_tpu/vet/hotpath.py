@@ -1,0 +1,162 @@
+"""Pass 6 — per-exec host-packing regressions (zero-copy ingest guard).
+
+The PR-11 ingest plane made the fuzzer proc loop's per-exec host work
+O(1) dispatches: covers travel executor→device as pinned-ring slab
+views, translated on device.  That boundary regresses silently — one
+`np.array([...])` or list comprehension on a per-exec path quietly
+reintroduces the host packing that made device replay lose to CPU
+(BENCH_r02).  This pass pins it: inside functions reachable from the
+fuzzer proc loop's per-exec path (a configured root set, plus
+same-module callees to depth 2), flag
+
+  - Python list materialization: list/set/dict comprehensions,
+    `list(...)` calls, and `for` loops (rule `host-list-iter`)
+  - numpy array construction from Python lists or comprehensions:
+    `np.array([...])`, `np.asarray([ ... for ... ])`,
+    `np.concatenate([...])`, `np.fromiter(...)` (rule `host-pack-np`)
+
+Findings are P1 — justified remnants (rare-path cover materialization
+for triage items, legacy cover-list entry points, cold-start fix-ups)
+are baselined with written reasons in vet-baseline.txt; anything new
+shows up in the counts and the bench extras.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from syzkaller_tpu.vet.core import P1, Finding, SourceFile, dotted
+
+# functions whose bodies (and same-module callees) sit on the fuzzer
+# proc loop's per-exec path; keyed by path suffix so fixtures can match
+ROOTS: dict[str, set[str]] = {
+    "fuzzer/fuzzer.py": {
+        "check_new_signal", "flush_signal", "_resolve_flush", "execute",
+        "_pick_corpus_row", "note_exec", "maybe_flush", "_submit",
+        "_resolve", "_count_drops",
+    },
+    "fuzzer/device_signal.py": {
+        "submit_slabs", "_resolve_slab", "_fixup_misses", "submit_batch",
+        "resolve", "_slabify", "_map_rows",
+    },
+    "ipc/ring.py": {"read_batch", "consume", "write"},
+    "ipc/env.py": {"exec", "_parse_output"},
+}
+
+MAX_DEPTH = 2
+NP_CONSTRUCTORS = {"array", "asarray", "concatenate", "fromiter",
+                   "stack", "vstack", "hstack"}
+
+
+def _roots_for(path: str) -> "set[str] | None":
+    for suffix, names in ROOTS.items():
+        if path.replace("\\", "/").endswith(suffix):
+            return names
+    return None
+
+
+def _func_index(tree: ast.AST) -> dict[str, ast.FunctionDef]:
+    out: dict[str, ast.FunctionDef] = {}
+    for node in ast.walk(tree):
+        if isinstance(node, ast.FunctionDef) and node.name not in out:
+            out[node.name] = node
+    return out
+
+
+class _Scanner:
+    def __init__(self, sf: SourceFile, findings: list[Finding]):
+        self.sf = sf
+        self.findings = findings
+        self.funcs = _func_index(sf.tree)
+        self.seen: set[int] = set()
+
+    def flag(self, rule: str, node: ast.AST, scope: str, msg: str,
+             hint: str, detail: str) -> None:
+        self.findings.append(Finding(
+            pass_name="hotpath", rule=rule, severity=P1,
+            path=self.sf.path, line=getattr(node, "lineno", 0),
+            scope=scope, message=msg, hint=hint, detail=detail))
+
+    def scan(self, fn: ast.FunctionDef, depth: int = 0) -> None:
+        if id(fn) in self.seen or depth > MAX_DEPTH:
+            return
+        self.seen.add(id(fn))
+        for node in ast.walk(fn):
+            if isinstance(node, (ast.ListComp, ast.SetComp, ast.DictComp)):
+                self.flag(
+                    "host-list-iter", node, fn.name,
+                    "comprehension on a per-exec hot path "
+                    f"({ast.unparse(node)[:60]})",
+                    "hot-path data must ride slab views / vectorized "
+                    "numpy — per-exec Python iteration regresses the "
+                    "zero-copy ingest boundary",
+                    f"comp:{ast.unparse(node)[:40].rstrip()}")
+            elif isinstance(node, ast.For):
+                if self._const_iter(node.iter):
+                    continue       # retry loops / literal tuples: not
+                    #                data-proportional iteration
+                self.flag(
+                    "host-list-iter", node, fn.name,
+                    "Python for-loop on a per-exec hot path "
+                    f"(over {ast.unparse(node.iter)[:50]})",
+                    "vectorize or move off the per-exec path",
+                    f"for:{ast.unparse(node.iter)[:40].rstrip()}")
+            elif isinstance(node, ast.Call):
+                self._call(node, fn.name, depth)
+
+    @staticmethod
+    def _const_iter(it: ast.expr) -> bool:
+        """True for iteration whose trip count is a source constant —
+        `for _ in range(3)` retry loops and literal-tuple walks don't
+        scale with exec/slab count."""
+        if isinstance(it, (ast.Tuple, ast.Constant)):
+            return all(isinstance(e, ast.Constant)
+                       for e in getattr(it, "elts", []))
+        if isinstance(it, ast.Call) and isinstance(it.func, ast.Name) \
+                and it.func.id == "range":
+            return all(isinstance(a, ast.Constant) for a in it.args)
+        return False
+
+    def _call(self, call: ast.Call, scope: str, depth: int) -> None:
+        d = dotted(call.func)
+        leaf = d.split(".")[-1] if d else ""
+        if d.startswith(("np.", "numpy.")) and leaf in NP_CONSTRUCTORS:
+            if any(isinstance(a, (ast.List, ast.ListComp,
+                                  ast.GeneratorExp)) for a in call.args):
+                self.flag(
+                    "host-pack-np", call, scope,
+                    f"{d}() over a Python list/comprehension on a "
+                    "per-exec hot path",
+                    "per-exec numpy packing is the boundary the slab "
+                    "ring retired — keep it off the hot loop",
+                    f"np:{leaf}")
+        elif isinstance(call.func, ast.Name) and call.func.id == "list":
+            self.flag(
+                "host-list-iter", call, scope,
+                "list(...) materialization on a per-exec hot path",
+                "keep per-exec data as arrays/views",
+                f"list:{ast.unparse(call)[:40].rstrip()}")
+        # follow same-module calls (depth-bounded)
+        fn = None
+        if isinstance(call.func, ast.Name):
+            fn = self.funcs.get(call.func.id)
+        elif isinstance(call.func, ast.Attribute) \
+                and isinstance(call.func.value, ast.Name) \
+                and call.func.value.id == "self":
+            fn = self.funcs.get(call.func.attr)
+        if fn is not None:
+            self.scan(fn, depth + 1)
+
+
+def check(files: list[SourceFile]) -> list[Finding]:
+    findings: list[Finding] = []
+    for sf in files:
+        roots = _roots_for(sf.path)
+        if not roots or sf.tree is None:
+            continue
+        sc = _Scanner(sf, findings)
+        for name in sorted(roots):
+            fn = sc.funcs.get(name)
+            if fn is not None:
+                sc.scan(fn)
+    return findings

@@ -1,0 +1,380 @@
+"""Zero-copy ingest plane: pinned PC ring + on-device PcMap translation.
+
+Pins the PR-11 contracts:
+  * ring wire protocol: roundtrip, pow2 bucketing, wrap, counted
+    full-drops, torn-slab skip + resync
+  * slab ingest verdicts bit-exact vs the legacy host-mapped update
+    path, including first-sight-key batches (host fix-up)
+  * zero warm recompiles across 1k mixed-size slab batches
+    (CompileCounter — pow2 × pow2 dispatch shape closure)
+  * PR 9 snapshot restore stays bit-exact with device-resident keys
+    (export_keys → preseed → identical translation + bitmaps)
+  * coalescer admission through admit_slabs ≡ the host-mapped
+    admit_batch on the same stream
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+from syzkaller_tpu.cover import sets
+from syzkaller_tpu.fuzzer.pcmap import DeviceKeyMirror, PcMap
+from syzkaller_tpu.ipc import ring as R
+
+pytestmark = pytest.mark.filterwarnings("ignore")
+
+
+@pytest.fixture()
+def ring(tmp_path):
+    r = R.PcRing.create(str(tmp_path / "ring"), data_words=1 << 12,
+                        index_slots=256, slab_cap=128)
+    yield r
+    r.close()
+
+
+# -- ring wire protocol ------------------------------------------------------
+
+
+def test_ring_roundtrip_and_wrap(ring):
+    w = R.RingWriter(ring)
+    rd = R.RingReader(ring)
+    rng = np.random.default_rng(0)
+    # several laps around the data region
+    for lap in range(30):
+        wrote = []
+        for i in range(16):
+            n = int(rng.integers(1, 100))
+            pcs = rng.integers(0, 2**32, n).astype(np.uint32)
+            assert w.write(lap * 16 + i, pcs)
+            wrote.append((lap * 16 + i, pcs))
+        got = []
+        while len(got) < 16:
+            b = rd.read_batch()
+            assert b is not None
+            for i in range(b.n):
+                got.append((int(b.tags[i]), b.cover(i).copy()))
+            rd.consume(b)
+        for (t1, p1), (t2, p2) in zip(wrote, got):
+            assert t1 == t2 and np.array_equal(p1, p2)
+    assert ring.load(R.H_DROPPED) == 0
+    assert ring.load(R.H_SKIPPED) == 0
+
+
+def test_ring_batches_are_zero_copy_views(ring):
+    w = R.RingWriter(ring)
+    rd = R.RingReader(ring)
+    for i in range(8):
+        w.write(i, np.arange(10, dtype=np.uint32) + i)
+    b = rd.read_batch()
+    assert b.n == 8
+    # the window aliases the mapped data region — no copy happened
+    assert b.win.base is not None
+    lo = ring.data.ctypes.data
+    hi = lo + ring.data.nbytes
+    assert lo <= b.win.ctypes.data < hi
+    rd.consume(b)
+
+
+def test_ring_full_drops_are_counted(tmp_path):
+    r = R.PcRing.create(str(tmp_path / "tiny"), data_words=64,
+                        index_slots=4, slab_cap=64)
+    w = R.RingWriter(r)
+    drops = sum(0 if w.write(i, np.arange(30, dtype=np.uint32)) else 1
+                for i in range(10))
+    assert drops > 0
+    assert r.load(R.H_DROPPED) == drops
+    # the committed slabs before the drops are intact
+    rd = R.RingReader(r)
+    n = 0
+    while (b := rd.read_batch()) is not None:
+        n += b.n
+        rd.consume(b)
+    assert n == 10 - drops
+    r.close()
+
+
+def test_ring_torn_slab_skip_and_resync(ring):
+    import threading
+
+    w = R.RingWriter(ring)
+    rd = R.RingReader(ring)
+    w.write(1, np.arange(10, dtype=np.uint32))
+    w.pause_before_commit = True
+    t = threading.Thread(
+        target=lambda: w.write(2, np.arange(5, dtype=np.uint32)),
+        daemon=True)
+    t.start()
+    import time
+    deadline = time.monotonic() + 10
+    while ring.load(R.H_RESV) < 2:
+        assert time.monotonic() < deadline
+        time.sleep(0.005)
+    b = rd.read_batch()
+    assert b is not None and b.n == 1       # committed prefix only
+    rd.consume(b)
+    assert rd.read_batch() is None          # blocked on the torn slab
+    assert rd.resync() == 1                 # skipped BY LENGTH PREFIX
+    assert ring.load(R.H_SKIPPED) == 1
+    # a new writer generation flows normally
+    w2 = R.RingWriter(ring)
+    w2.write(3, np.arange(7, dtype=np.uint32))
+    b = rd.read_batch()
+    assert b is not None and int(b.tags[0]) == 3
+    rd.consume(b)
+
+
+def test_ring_pow2_bucketing_keeps_runs_contiguous(tmp_path):
+    r = R.PcRing.create(str(tmp_path / "rb"), data_words=1 << 12,
+                        index_slots=128, slab_cap=128, min_bucket=32)
+    w = R.RingWriter(r)
+    rd = R.RingReader(r)
+    for i in range(8):
+        w.write(i, np.arange(5 + i, dtype=np.uint32))    # all ≤ 32
+    w.write(99, np.arange(100, dtype=np.uint32))         # bucket 128
+    b = rd.read_batch()
+    assert b.n == 8 and b.bucket == 32       # one uniform-bucket run
+    rd.consume(b)
+    b2 = rd.read_batch()
+    assert b2.n == 1 and b2.bucket == 128
+    rd.consume(b2)
+    r.close()
+
+
+# -- slab ingest vs legacy host-mapped path ---------------------------------
+
+
+def _mk_signal(npcs=1 << 12, **kw):
+    from syzkaller_tpu.fuzzer.device_signal import DeviceSignal
+    from syzkaller_tpu.telemetry import DeviceStats
+
+    return DeviceSignal(ncalls=16, npcs=npcs, flush_batch=8,
+                        max_pcs=64, corpus_cap=256,
+                        telemetry=DeviceStats(), **kw)
+
+
+def _legacy_update(eng_npcs, stream):
+    """Reference verdicts: a fresh engine driven through the
+    host-mapped update path over the same (call_id, cover) stream."""
+    from syzkaller_tpu.cover.engine import CoverageEngine
+
+    eng = CoverageEngine(npcs=eng_npcs, ncalls=16, corpus_cap=256)
+    pm = PcMap(eng_npcs)
+    out = []
+    for batch in stream:
+        covers = [sets.canonicalize(c) for _, c in batch]
+        idx, valid, owner = pm.map_rows(covers, 64, chunk=True,
+                                        pad_rows=8)
+        call_ids = np.zeros((idx.shape[0],), np.int32)
+        m = owner >= 0
+        call_ids[m] = np.array([batch[o][0] for o in owner[m]], np.int32)
+        res = eng.update_batch(call_ids, idx, valid)
+        per = np.zeros((len(batch),), bool)
+        mm = (owner >= 0) & res.has_new[: len(owner)]
+        np.logical_or.at(per, owner[mm], True)
+        out.append(per)
+    return out, eng, pm
+
+
+def test_submit_slabs_verdicts_match_host_path_with_new_keys():
+    """The pipelined slab path (device translation + host fix-up for
+    first-sight keys) produces the exact has-new verdicts of the
+    host-mapped path over the same stream — new-key batches included."""
+    rng = np.random.default_rng(3)
+    stream = []
+    for _ in range(12):
+        batch = []
+        for _ in range(8):
+            n = int(rng.integers(1, 50))
+            cov = np.unique(rng.integers(0, 3000, n)).astype(np.uint32)
+            batch.append((int(rng.integers(0, 16)), cov))
+        stream.append(batch)
+    want, _eng, _pm = _legacy_update(1 << 12, stream)
+
+    sig = _mk_signal()
+    got = [sig.check_batch(batch) for batch in stream]
+    for a, b in zip(want, got):
+        assert np.array_equal(a, b)
+    # the fix-up path actually ran (cold start = first-sight keys)
+    assert sig.stat_ingest_fixups > 0
+    # and export_keys order is IDENTICAL to the host path's first-seen
+    # order — the PR 9 snapshot contract
+    assert np.array_equal(sig.pcmap.export_keys(), _pm.export_keys())
+
+
+def test_triage_and_merge_slab_paths_match_host_sets():
+    sig = _mk_signal()
+    cov1 = np.arange(100, 160, dtype=np.uint32)
+    assert sig.check_batch([(3, cov1)])[0]
+    sig.merge_corpus(3, cov1, corpus_index=0)
+    # triage gate: only genuinely new PCs survive
+    cov2 = np.concatenate([cov1[:20],
+                           np.arange(500, 520, dtype=np.uint32)])
+    new = sig.triage_new(3, cov2.astype(np.uint32))
+    assert np.array_equal(np.sort(new),
+                          np.arange(500, 520, dtype=np.uint32))
+    # flakes subtract from the gate
+    sig.add_flakes(3, np.arange(500, 510, dtype=np.uint32))
+    new2 = sig.triage_new(3, cov2.astype(np.uint32))
+    assert np.array_equal(np.sort(new2),
+                          np.arange(510, 520, dtype=np.uint32))
+
+
+def test_long_cover_chunks_preserved():
+    """Covers longer than the slab K spread over chunk rows — no PC is
+    silently dropped by the legacy entry points."""
+    sig = _mk_signal()
+    cov = np.arange(1000, 1000 + 150, dtype=np.uint32)   # > K=64
+    assert sig.check_batch([(2, cov)])[0]
+    sig.merge_corpus(2, cov, corpus_index=0)
+    assert len(sig.triage_new(2, cov)) == 0     # ALL of it is in corpus
+
+
+def test_ingest_zero_warm_recompiles_1k_mixed_batches():
+    """1k mixed-size slab batches through the fused translate+update
+    dispatch compile NOTHING once the pow2 × pow2 shape closure is
+    warm."""
+    from syzkaller_tpu.vet.runtime import CompileCounter
+
+    sig = _mk_signal()
+    mirror = sig.mirror
+    eng = sig.engine
+    rng = np.random.default_rng(7)
+    pm = sig.pcmap
+    pm.preseed(np.arange(0, 3000, dtype=np.uint64))
+    mirror.refresh()
+    Bs = [1, 2, 4, 8]
+    Ks = [8, 16, 32, 64]
+    # warm the closure
+    for B in Bs:
+        for K in Ks:
+            win = rng.integers(0, 3000, (B, K)).astype(np.uint32)
+            counts = rng.integers(1, K + 1, B).astype(np.int32)
+            cids = rng.integers(0, 16, B).astype(np.int32)
+            np.asarray(eng.ingest_update_slabs(
+                win, counts, cids, mirror).has_new)
+    with CompileCounter() as cc:
+        for _ in range(1000):
+            B = Bs[int(rng.integers(len(Bs)))]
+            K = Ks[int(rng.integers(len(Ks)))]
+            win = rng.integers(0, 3000, (B, K)).astype(np.uint32)
+            counts = rng.integers(1, K + 1, B).astype(np.int32)
+            cids = rng.integers(0, 16, B).astype(np.int32)
+            res = eng.ingest_update_slabs(win, counts, cids, mirror)
+        np.asarray(res.has_new)
+    assert cc.count == 0, f"{cc.count} warm recompiles"
+
+
+def test_snapshot_restore_bit_exact_with_device_keys():
+    """export_keys → fresh map + mirror → identical translation AND
+    identical bitmaps for the same replayed covers (the PR 9 restore
+    path with the translation device-resident)."""
+    from syzkaller_tpu.cover.engine import CoverageEngine
+
+    rng = np.random.default_rng(11)
+    covers = [np.unique(rng.integers(0, 4000, 40)).astype(np.uint32)
+              for _ in range(20)]
+    cids = rng.integers(0, 8, 20).astype(np.int32)
+
+    def run(pm_seed_keys=None):
+        eng = CoverageEngine(npcs=1 << 12, ncalls=8, corpus_cap=64)
+        pm = PcMap(1 << 12)
+        mirror = DeviceKeyMirror(pm, put=eng.put_replicated)
+        if pm_seed_keys is not None:
+            pm.preseed(pm_seed_keys)
+        for c in covers:                    # host inserts first-seen
+            pm.map_flat(c.astype(np.uint64))
+        mirror.refresh()
+        win = np.zeros((32, 64), np.uint32)
+        counts = np.zeros((32,), np.int32)
+        ids = np.zeros((32,), np.int32)
+        for i, c in enumerate(covers):
+            win[i, : len(c)] = c
+            counts[i] = len(c)
+            ids[i] = cids[i]
+        res = eng.ingest_update_slabs(win, counts, ids, mirror)
+        np.asarray(res.has_new)
+        return pm, np.asarray(eng.max_cover)
+
+    pm1, cover1 = run()
+    keys = pm1.export_keys()
+    pm2, cover2 = run(pm_seed_keys=keys)
+    assert np.array_equal(pm1.export_keys(), pm2.export_keys())
+    assert np.array_equal(cover1, cover2), "restored bitmaps diverged"
+
+
+# -- coalescer slab admission ------------------------------------------------
+
+
+def test_admit_slabs_matches_admit_batch():
+    from syzkaller_tpu.cover.engine import CoverageEngine
+
+    rng = np.random.default_rng(5)
+    batches = []
+    for _ in range(6):
+        covs = [np.unique(rng.integers(0, 2000, 24)).astype(np.uint32)
+                for _ in range(8)]
+        cids = rng.integers(0, 8, 8).astype(np.int32)
+        batches.append((covs, cids))
+
+    # host-mapped reference
+    engA = CoverageEngine(npcs=1 << 12, ncalls=8, corpus_cap=128)
+    pmA = PcMap(1 << 12)
+    wantA = []
+    for covs, cids in batches:
+        idx, valid = pmA.map_batch(covs, K=32)
+        hn, rows, _ch = engA.admit_batch(
+            cids, idx, valid, choice_prev=np.full((4,), -1, np.int32))
+        wantA.append((hn.copy(), None if rows is None else rows.copy()))
+
+    # slab path
+    engB = CoverageEngine(npcs=1 << 12, ncalls=8, corpus_cap=128)
+    pmB = PcMap(1 << 12)
+    mirror = DeviceKeyMirror(pmB, put=engB.put_replicated)
+    gotB = []
+    for covs, cids in batches:
+        win = np.zeros((8, 32), np.uint32)
+        counts = np.zeros((8,), np.int32)
+        for i, c in enumerate(covs):
+            win[i, : len(c[:32])] = c[:32]
+            counts[i] = len(c[:32])
+        live = np.arange(32)[None, :] < counts[:, None]
+        mirror.ensure(win[live])
+        hn, rows, _ch = engB.admit_slabs(
+            win, counts, cids, choice_prev=np.full((4,), -1, np.int32),
+            mirror=mirror)
+        gotB.append((hn, rows))
+
+    for (ha, ra), (hb, rb) in zip(wantA, gotB):
+        assert np.array_equal(ha, hb)
+        assert np.array_equal(ra, rb)
+    assert np.array_equal(np.asarray(engA.corpus_cover),
+                          np.asarray(engB.corpus_cover))
+    assert engA.corpus_len == engB.corpus_len
+
+
+def test_admit_slabs_rejects_unresolved_misses():
+    from syzkaller_tpu.cover.engine import CoverageEngine
+
+    eng = CoverageEngine(npcs=1 << 12, ncalls=4, corpus_cap=16)
+    pm = PcMap(1 << 12)
+    mirror = DeviceKeyMirror(pm, put=eng.put_replicated)
+    mirror.refresh()
+    win = np.zeros((1, 8), np.uint32)
+    win[0, :3] = [5, 6, 7]
+    with pytest.raises(ValueError, match="first-sight"):
+        eng.admit_slabs(win, np.array([3], np.int32),
+                        np.array([0], np.int32),
+                        choice_prev=np.full((4,), -1, np.int32),
+                        mirror=mirror)
+
+
+def test_ingest_telemetry_series_present():
+    sig = _mk_signal()
+    sig.check_batch([(1, np.arange(50, 90, dtype=np.uint32))])
+    snap = sig.tstats.snapshot()
+    assert snap["syz_ingest_slabs_total"] >= 1
+    assert snap["syz_ingest_bytes_total"] >= 40 * 4
+    assert snap["syz_ingest_dispatches_total"] >= 1
+    assert snap["syz_ingest_new_keys_total"] >= 40
+    assert snap["syz_ingest_batch_translate_seconds"]["count"] >= 1

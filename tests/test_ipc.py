@@ -149,6 +149,58 @@ def test_executor_restart_after_kill(env, table):
     assert res.per_call(1)[0] is not None
 
 
+def test_executor_ring_slabs_match_shm_covers(table):
+    """The native executor writes every covered call's PCs into the
+    pinned slab ring, matching the shm-out records byte for byte; a
+    FLAG_RING_SKIP exec leaves the ring untouched."""
+    rand = P.Rand(np.random.default_rng(7))
+    env2 = ipc.Env(flags=BASE_FLAGS, pid=3, ring=True)
+    try:
+        for _ in range(5):
+            p = P.generate(rand, table, 8, None)
+            res = env2.exec(p)
+            slabs = []
+            while (b := env2.ring_reader.read_batch()) is not None:
+                for i in range(b.n):
+                    slabs.append((int(b.tags[i]), b.cover(i)))
+                env2.ring_reader.consume(b)
+            shm = [(c.index, c.cover) for c in res.calls if len(c.cover)]
+            assert len(shm) == len(slabs)
+            for (i1, c1), (i2, c2) in zip(shm, slabs):
+                assert i1 == i2
+                assert np.array_equal(c1[: env2.ring.slab_cap], c2)
+        # ring-skip: re-executions must not pollute the slab stream
+        p = P.generate(rand, table, 8, None)
+        res = env2.exec(p, extra_flags=ipc.FLAG_RING_SKIP)
+        assert any(len(c.cover) for c in res.calls)
+        assert env2.ring_reader.read_batch() is None
+    finally:
+        env2.close()
+
+
+def test_executor_ring_survives_restart(table):
+    """A SIGKILLed executor re-attaches to the same ring and keeps
+    appending; the reader resyncs past anything torn."""
+    rand = P.Rand(np.random.default_rng(9))
+    env2 = ipc.Env(flags=BASE_FLAGS, pid=4, ring=True)
+    try:
+        p = P.generate(rand, table, 6, None)
+        env2.exec(p)
+        os.kill(env2._proc.pid, signal.SIGKILL)
+        env2._proc.wait()
+        res = env2.exec(p)          # relaunches transparently
+        assert res.restarted
+        env2.ring_resync()          # no torn slab expected, must be a no-op
+        n = 0
+        while (b := env2.ring_reader.read_batch()) is not None:
+            n += b.n
+            env2.ring_reader.consume(b)
+        ncov = sum(1 for c in res.calls if len(c.cover))
+        assert n >= ncov            # both generations' slabs landed
+    finally:
+        env2.close()
+
+
 def test_gate():
     order = []
     g = ipc.Gate(2, callback=lambda: order.append("cb"))

@@ -1,0 +1,170 @@
+"""PcMap edge cases + device-mirror translation exactness.
+
+The sparse→dense translation now runs in two places — the host
+open-addressing table (`PcMap._lookup`) and the device sorted-mirror
+binary search (`cover/engine.py translate_slab_rows`) — and the PR 9
+snapshots serialize only the host side's first-seen key order.  These
+tests pin the two bit-exact against each other on the paths that have
+historically drifted: duplicate PCs across rows, hashed-overflow
+exhaustion, and preseed-then-map ordering.
+"""
+
+import numpy as np
+import pytest
+
+from syzkaller_tpu.fuzzer.pcmap import DeviceKeyMirror, PcMap
+
+pytestmark = pytest.mark.filterwarnings("ignore")
+
+
+def _device_translate(pm: PcMap, covers, K=64, npcs=None):
+    """Translate covers through the device kernel; returns per-row
+    index arrays aligned to each cover's occurrence order."""
+    from syzkaller_tpu.cover.engine import CoverageEngine
+
+    eng = CoverageEngine(npcs=npcs or pm.npcs, ncalls=8, corpus_cap=8)
+    mirror = DeviceKeyMirror(pm, put=eng.put_replicated)
+    B = len(covers)
+    win = np.zeros((B, K), np.uint32)
+    counts = np.zeros((B,), np.int32)
+    for i, c in enumerate(covers):
+        c = np.asarray(c, np.uint32)[:K]
+        win[i, : len(c)] = c
+        counts[i] = len(c)
+    _hn, _new, _bm, idx, miss = eng.triage_diff_slabs(
+        win, counts, np.zeros((B,), np.int32), mirror)
+    return np.asarray(idx), np.asarray(miss), counts
+
+
+# -- host map edge cases ----------------------------------------------------
+
+
+def test_map_rows_duplicate_pcs_across_rows():
+    """The same PC in several rows maps to ONE dense index everywhere,
+    and each row's valid entries stay duplicate-free (the MXU pack
+    requires it)."""
+    pm = PcMap(1 << 10, reserve_overflow=64)
+    shared = np.array([7, 9, 11], np.uint64)
+    covers = [np.array([7, 9, 11, 100], np.uint64),
+              np.array([9, 7, 200], np.uint64),
+              np.array([11, 11, 7], np.uint64)]   # in-row dup too
+    idx, valid, owner = pm.map_rows(covers, K=8)
+    for i, c in enumerate(covers):
+        vals = idx[i][valid[i]]
+        assert len(np.unique(vals)) == len(vals), "in-row dup survived"
+    # every occurrence of a shared PC resolves to the same index
+    for pc in shared:
+        want = pm.index_of(int(pc))
+        for i, c in enumerate(covers):
+            got = idx[i][: len(c)][np.asarray(c[: 8]) == pc]
+            got = got[valid[i][: len(c)][np.asarray(c[: 8]) == pc]]
+            assert all(g == want for g in got)
+
+
+def test_map_batch_overflow_reserve_exhaustion():
+    """Past direct capacity new PCs land in the hashed overflow region:
+    stable (same PC → same index), bounded, counted."""
+    pm = PcMap(128, reserve_overflow=32)     # direct cap 96
+    first = np.arange(1000, 1096, dtype=np.uint64)
+    pm.preseed(first)
+    assert len(pm) == 96
+    over = np.arange(5000, 5040, dtype=np.uint64)
+    idx1, valid1 = pm.map_batch([over], K=64)
+    idx2, valid2 = pm.map_batch([over], K=64)
+    live1 = idx1[0][valid1[0]]
+    # overflow indices sit in the reserved tail and are deterministic
+    assert (live1 >= 96).all() and (live1 < 128).all()
+    assert len(pm) == 96                     # nothing memoized
+    assert pm.overflow_hits > 0
+    # stability: the re-map agrees wherever the same PC survived dedup
+    m1 = {int(p): int(v) for p, v, ok in
+          zip(over, idx1[0], valid1[0]) if ok}
+    m2 = {int(p): int(v) for p, v, ok in
+          zip(over, idx2[0], valid2[0]) if ok}
+    for p in m1.keys() & m2.keys():
+        assert m1[p] == m2[p]
+
+
+def test_preseed_then_map_flat_ordering():
+    """preseed assigns indices in first-seen order; later map_flat of a
+    mix of preseeded + fresh PCs extends the sequence without
+    disturbing existing assignments — the export_keys/restore
+    contract."""
+    pm = PcMap(1 << 10, reserve_overflow=64)
+    seed = np.array([10, 20, 30, 40], np.uint64)
+    pm.preseed(seed)
+    assert [pm.index_of(int(p)) for p in seed] == [0, 1, 2, 3]
+    out = pm.map_flat(np.array([30, 50, 10, 60, 50], np.uint64))
+    assert list(out) == [2, 4, 0, 5, 4]      # fresh keys: first-seen
+    # export → preseed into a fresh map reproduces every assignment
+    keys = pm.export_keys()
+    pm2 = PcMap(1 << 10, reserve_overflow=64)
+    pm2.preseed(keys)
+    for p in [10, 20, 30, 40, 50, 60]:
+        assert pm2.index_of(p) == pm.index_of(p)
+
+
+# -- device translation bit-exactness ---------------------------------------
+
+
+def test_device_translation_matches_host_duplicates():
+    pm = PcMap(1 << 10, reserve_overflow=64)
+    covers = [np.array([7, 9, 11, 100], np.uint64),
+              np.array([9, 7, 200], np.uint64),
+              np.array([11, 11, 7], np.uint64)]
+    pm.map_rows(covers, K=8)                 # host inserts first
+    idx, miss, counts = _device_translate(pm, covers, K=8)
+    assert not miss.any()
+    for i, c in enumerate(covers):
+        host = pm.indices_of(c)
+        assert np.array_equal(idx[i, : len(c)], host), i
+
+
+def test_device_translation_matches_host_overflow_exhaustion():
+    pm = PcMap(128, reserve_overflow=32)
+    pm.preseed(np.arange(1000, 1096, dtype=np.uint64))   # table full
+    probes = [np.array([1000, 1095, 77, 999999, 2**32 - 1], np.uint64)]
+    idx, miss, _ = _device_translate(pm, probes, K=8, npcs=128)
+    # full table: the kernel computes the hashed overflow itself —
+    # no host round trip, no miss
+    assert not miss.any()
+    host = pm.indices_of(probes[0])
+    assert np.array_equal(idx[0, :5], host)
+
+
+def test_device_translation_matches_host_after_preseed_order():
+    pm = PcMap(1 << 10, reserve_overflow=64)
+    pm.preseed(np.array([10, 20, 30, 40], np.uint64))
+    pm.map_flat(np.array([30, 50, 10, 60], np.uint64))
+    covers = [np.array([10, 30, 50, 60, 20], np.uint64)]
+    idx, miss, _ = _device_translate(pm, covers, K=8)
+    assert not miss.any()
+    assert np.array_equal(idx[0, :5], pm.indices_of(covers[0]))
+
+
+def test_device_mirror_flags_first_sight_keys():
+    """A probe the host map has never seen (table not full) is a MISS —
+    the kernel must not invent an index for it."""
+    pm = PcMap(1 << 10, reserve_overflow=64)
+    pm.preseed(np.array([1, 2, 3], np.uint64))
+    covers = [np.array([1, 2, 999], np.uint64)]
+    idx, miss, _ = _device_translate(pm, covers, K=8)
+    assert miss.any()
+    # the known keys still translated exactly
+    assert idx[0, 0] == pm.index_of(1) and idx[0, 1] == pm.index_of(2)
+
+
+def test_device_mirror_refresh_tracks_insertions():
+    from syzkaller_tpu.cover.engine import CoverageEngine
+
+    pm = PcMap(1 << 10, reserve_overflow=64)
+    eng = CoverageEngine(npcs=1 << 10, ncalls=4, corpus_cap=8)
+    mirror = DeviceKeyMirror(pm, put=eng.put_replicated)
+    mirror.refresh()
+    r0 = mirror.stat_refreshes
+    added = mirror.ensure(np.array([42, 43, 42], np.uint64))
+    assert added == 2
+    assert mirror.stat_refreshes == r0 + 1
+    # idempotent: no growth, no refresh
+    assert mirror.ensure(np.array([42, 43], np.uint64)) == 0
+    assert mirror.stat_refreshes == r0 + 1

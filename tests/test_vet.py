@@ -716,6 +716,66 @@ def capture(seconds):
     assert {f.line for f in fa} != {f.line for f in fb}
 
 
+# -- pass 6: per-exec host packing (zero-copy ingest guard) -----------------
+
+
+HOTPATH_SEEDED = """
+import numpy as np
+
+class Fuzzer:
+    def check_new_signal(self, p, res):
+        items = [(p, c.index, c.cover) for c in res.calls]
+        arr = np.array([c.cover for c in res.calls])
+        for c in res.calls:
+            self.handle(c)
+        return list(items)
+"""
+
+HOTPATH_CLEAN = """
+import numpy as np
+
+class Fuzzer:
+    def check_new_signal(self, batch, counts, call_ids):
+        # slab-view flow: vectorized ops over ring windows only
+        live = counts > 0
+        call_ids = np.where(live, call_ids, 0)
+        return self.signal.submit_slabs(batch.win, counts, call_ids)
+
+    def execute(self, env, p):
+        for attempt in range(3):      # constant retry loop: not flagged
+            res = env.exec(p)
+            if res is not None:
+                return res
+"""
+
+
+def test_hotpath_seeded_packing_caught():
+    f = run(HOTPATH_SEEDED, ["hotpath"], path="fuzzer/fuzzer.py")
+    assert "host-list-iter" in rules(f)
+    assert "host-pack-np" in rules(f)
+    assert all(x.severity == "P1" for x in f)
+    # comprehension, np.array-over-comp, data for-loop, list() — all hit
+    assert len(f) >= 4
+
+
+def test_hotpath_clean_slab_flow_quiet():
+    assert run(HOTPATH_CLEAN, ["hotpath"], path="fuzzer/fuzzer.py") == []
+
+
+def test_hotpath_only_fires_on_per_exec_roots():
+    # same seeded body under a non-root path: out of scope, no findings
+    assert run(HOTPATH_SEEDED, ["hotpath"], path="manager/html.py") == []
+
+
+def test_hotpath_real_tree_remnants_all_baselined():
+    """The audited remnants on the real tree carry justifications —
+    an unbaselined hotpath finding means the ingest boundary regressed."""
+    rep = vet.run_repo()
+    loose = [f for f in rep.findings
+             if f.pass_name == "hotpath" and not f.baselined]
+    assert not loose, "\n".join(f.render() for f in loose)
+
+
 # -- the gate itself --------------------------------------------------------
 
 
@@ -740,7 +800,7 @@ def test_vet_cli_json(capsys):
     assert rep["ok"] is True
     assert rep["counts"]["p0_unbaselined"] == 0
     assert set(rep["counts"]["by_pass"]) <= {
-        "lock", "purity", "retrace", "schema", "stats"}
+        "lock", "purity", "retrace", "schema", "stats", "hotpath"}
 
 
 def test_parse_error_blocks_gate(tmp_path):

@@ -52,6 +52,12 @@ def main(argv=None) -> int:
             out = chaos.run_kill_restore_cycle(base, n_inputs=n,
                                                verbose=verbose)
             out["inputs"] = n
+        if not args.autopilot_only:
+            # zero-copy ingest fold-in: SIGKILL a ring writer
+            # mid-slab-write; the reader must skip the torn slab
+            # (counted, not crashed) and the ring must resync
+            out["ring"] = chaos.run_ring_chaos(
+                os.path.join(base, "ring"), verbose=verbose)
         if not args.no_autopilot:
             # the compound-failure cycle: kill 2 of N VM threads + flap
             # the backend + wedge a campaign, autopilot remediates all
