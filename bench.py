@@ -1047,6 +1047,63 @@ def bench_autopilot(smoke=False):
     return out
 
 
+def bench_synth(smoke=False):
+    """Device-resident program synthesis vs the host generator: the
+    synth_block megakernel emits B complete exec-bytecode programs per
+    dispatch (resolve included — provenance unpack and all), measured
+    against per-program host Python generate+serialize on the same
+    backend.  Also pins warm recompiles across the timed loop with the
+    tables GROWING mid-stream (contents-only appends)."""
+    import time as _t
+
+    from syzkaller_tpu import prog as P
+    from syzkaller_tpu.cover.engine import CoverageEngine
+    from syzkaller_tpu.fuzzer.synth import DeviceSynth
+    from syzkaller_tpu.prog.encodingexec import serialize_for_exec
+    from syzkaller_tpu.sys.table import load_table
+    from syzkaller_tpu.vet.runtime import CompileCounter
+
+    table = load_table(files=["probe.txt"])
+    eng = CoverageEngine(npcs=1 << 12, ncalls=table.count,
+                         corpus_cap=64, seed=5)
+    eng.set_enabled(range(table.count))
+    ds = DeviceSynth(eng, table, batch=64 if smoke else 256)
+    rand = P.Rand(np.random.default_rng(9))
+    ds.build_templates(range(table.count), rand)
+    rows = 0
+    while rows < 8:
+        rows += bool(ds.add_program(P.generate(rand, table, 6)))
+
+    # host generator baseline: the per-program inner loop the
+    # megakernel retires (generate + exec serialization)
+    seconds = 0.4 if smoke else 2.0
+    t0 = _t.monotonic()
+    m = 0
+    while _t.monotonic() - t0 < seconds:
+        serialize_for_exec(P.generate(rand, table, 6))
+        m += 1
+    host_rate = m / (_t.monotonic() - t0)
+
+    ds.resolve(ds.dispatch())            # warm compile
+    grown = 0
+    with CompileCounter() as cc:
+        t0 = _t.monotonic()
+        n = 0
+        while _t.monotonic() - t0 < seconds:
+            n += len(ds.resolve(ds.dispatch()).progs)
+            if grown < 2:                # grow mid-loop: contents only
+                grown += bool(ds.add_program(
+                    P.generate(rand, table, 6)))
+        dev_rate = n / (_t.monotonic() - t0)
+    return {
+        "programs_per_sec_device": round(dev_rate, 1),
+        "programs_per_sec_host": round(host_rate, 1),
+        "synth_speedup": round(dev_rate / max(host_rate, 1e-9), 2),
+        "synth_recompiles_warm": cc.count,
+        "synth_templates": ds.n_templates,
+    }
+
+
 def _stage(name):
     sys.stderr.write(f"[bench] {name}\n")
     sys.stderr.flush()
@@ -1152,6 +1209,8 @@ def main(argv=None):
     _stage("decision stream")
     extras.update(bench_decision_stream(
         seconds=0.5 if args.smoke else 2.0, smoke=args.smoke))
+    _stage("device program synthesis")
+    extras.update(bench_synth(smoke=args.smoke))
     _stage("triage dedup")
     extras.update(bench_triage(np.random.default_rng(17),
                                smoke=args.smoke))

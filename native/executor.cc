@@ -98,6 +98,7 @@ const uint64_t FLAG_SANDBOX_NAMESPACE = 1 << 6;
 const uint64_t FLAG_FAKE_COVER = 1 << 7;
 const uint64_t FLAG_ENABLE_TUN = 1 << 8;
 const uint64_t FLAG_RING_SKIP = 1 << 9; // this exec's covers skip the ring
+const uint64_t FLAG_PROG_RING = 1 << 10; // read program from the prog ring
 
 // exit statuses (ref common.h:46-48, decoded by ipc/env.py)
 const int kFailStatus = 67;
@@ -919,6 +920,81 @@ static void ring_write(uint32_t tag, uint32_t* pcs, uint32_t n)
 	__atomic_store_n(&rec[0], 1u, __ATOMIC_RELEASE);
 }
 
+// ---------------------------------------------------------------------------
+// Program slab ring (device→executor). Same wire layout, run the other
+// way: the fuzzer writes complete exec-bytecode programs (u64 words as
+// LE u32 pairs, npcs = live u32 words) and THIS process is the reader.
+// A FLAG_PROG_RING exec reads the next committed slab straight off the
+// shared mapping — the program never crosses shm-in — and consumes it
+// after the run (tail/consumed advance, release order), so a kill
+// mid-exec leaves the slab unconsumed and the fuzzer-side
+// skip_committed() restores alignment.
+
+static RingHdr* prog_hdr;
+static uint32_t* prog_index;
+static uint32_t* prog_data;
+
+static void prog_ring_attach(int fd)
+{
+	struct stat st;
+	if (fstat(fd, &st) || (size_t)st.st_size < sizeof(RingHdr))
+		return;
+	char* m = (char*)mmap(NULL, st.st_size, PROT_READ | PROT_WRITE,
+			      MAP_SHARED, fd, 0);
+	if (m == MAP_FAILED)
+		return;
+	RingHdr* h = (RingHdr*)m;
+	if (h->magic != kRingMagic)
+		return;
+	prog_hdr = h;
+	prog_index = (uint32_t*)(m + sizeof(RingHdr));
+	prog_data = prog_index + h->index_slots * 4;
+}
+
+// returns the next committed program slab (u64-aligned: buckets are
+// pow2 >= 128 u32 words) or NULL when none is available; *nwords64 is
+// the u64 word count. Does NOT consume — call prog_ring_consume after
+// the run so a mid-exec death leaves the slab for skip_committed.
+static uint64_t* prog_ring_next(uint64_t* nwords64, uint32_t* npcs_out)
+{
+	RingHdr* h = prog_hdr;
+	if (!h)
+		return NULL;
+	uint64_t cons = h->consumed_idx;
+	uint64_t resv = __atomic_load_n(&h->resv_idx, __ATOMIC_ACQUIRE);
+	if (cons >= resv)
+		return NULL;
+	uint32_t* rec = prog_index + (cons % h->index_slots) * 4;
+	if (!__atomic_load_n(&rec[0], __ATOMIC_ACQUIRE))
+		return NULL; // torn (writer died mid-write): fuzzer resyncs
+	uint32_t npcs = rec[2];
+	uint32_t off = rec[3];
+	if (npcs < 2 || npcs > h->slab_cap || off + npcs > h->data_words)
+		return NULL;
+	*nwords64 = npcs / 2;
+	*npcs_out = npcs;
+	return (uint64_t*)(prog_data + off);
+}
+
+static void prog_ring_consume(uint32_t npcs)
+{
+	RingHdr* h = prog_hdr;
+	uint64_t cons = h->consumed_idx;
+	uint32_t* rec = prog_index + (cons % h->index_slots) * 4;
+	uint64_t bucket = kRingMinBucket;
+	if (h->min_bucket > bucket)
+		bucket = h->min_bucket;
+	uint64_t n = npcs ? npcs : 1;
+	while (bucket < n)
+		bucket <<= 1;
+	uint64_t dw = h->data_words;
+	uint64_t tail = h->tail_words;
+	uint64_t delta = (rec[3] - tail % dw) % dw; // wrap padding
+	__atomic_store_n(&h->tail_words, tail + delta + bucket,
+			 __ATOMIC_RELEASE);
+	__atomic_store_n(&h->consumed_idx, cons + 1, __ATOMIC_RELEASE);
+}
+
 static void write_output(Call* c, long retval, int err, uint32_t* cover,
 			 uint32_t n)
 {
@@ -1510,6 +1586,11 @@ int main(int argc, char** argv)
 		if (kRingFd >= 0)
 			ring_attach(kRingFd);
 	}
+	if (argc >= 7) {
+		int pfd = atoi(argv[6]);
+		if (pfd >= 0)
+			prog_ring_attach(pfd);
+	}
 	input_data = (char*)mmap(NULL, kInSize, PROT_READ, MAP_SHARED, kInFd, 0);
 	if (input_data == MAP_FAILED)
 		fail("mmap of input shm failed");
@@ -1547,15 +1628,34 @@ int main(int argc, char** argv)
 		if (flags & FLAG_ENABLE_TUN)
 			initialize_tun(proc_pid); // once; workers inherit the fd
 
-		if (prog_len * 8 > kInSize - 24)
-			fail("program too large");
-		decode_prog(words + 3, prog_len, &prog, data_copy);
+		uint32_t slab_npcs = 0;
+		if (flags & FLAG_PROG_RING) {
+			// slab-attach path: the program lives in the
+			// shared program ring, not shm-in
+			uint64_t nw64 = 0;
+			uint64_t* pw = prog_ring_next(&nw64, &slab_npcs);
+			if (!pw) {
+				// no committed slab: the fuzzer raced a
+				// restart — retryable, never fatal
+				char rep = (char)kRetryStatus;
+				if (write(kRepFd, &rep, 1) != 1)
+					fail("reply pipe write failed");
+				continue;
+			}
+			decode_prog(pw, nw64, &prog, data_copy);
+		} else {
+			if (prog_len * 8 > kInSize - 24)
+				fail("program too large");
+			decode_prog(words + 3, prog_len, &prog, data_copy);
+		}
 
 		// reset output
 		memset(output_data, 0, 64);
 		output_pos = (uint32_t*)(output_data + 8);
 
 		int status = run_worker(&prog);
+		if (flags & FLAG_PROG_RING)
+			prog_ring_consume(slab_npcs);
 		char rep = (char)status;
 		if (write(kRepFd, &rep, 1) != 1)
 			fail("reply pipe write failed");

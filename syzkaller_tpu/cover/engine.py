@@ -731,6 +731,33 @@ class DecisionBlock:
 
 
 @dataclass
+class SynthBlock:
+    """One program-synthesis megakernel emission: B complete exec-
+    bytecode programs as a fixed-layout (B, 2L) uint32 slab matrix
+    (u64 words split little-endian lo/hi — exactly the program-ring
+    wire format) plus the per-program operator provenance the host
+    needs for attribution, replay (slab→Prog for triage/csource), and
+    the distribution-equivalence tests.  Every field is a device array
+    fetched at resolve time — the dispatch never blocks."""
+    out32: jax.Array        # (B, 2L) uint32 program slabs (EOF included)
+    lens32: jax.Array       # (B,) int32 live u32 words per slab
+    op: jax.Array           # (B,) operator (prog.synth OP_*)
+    r1: jax.Array           # (B,) primary corpus row
+    r2: jax.Array           # (B,) splice donor row
+    cut: jax.Array          # (B,) splice insertion call index
+    pos: jax.Array          # (B,) insert-call position
+    dele: jax.Array         # (B,) squash removed call (-1 = no-op)
+    k: jax.Array            # (B,) generate call count
+    gen_cids: jax.Array     # (B, GMAX) generate call-id chain
+    ins_cid: jax.Array      # (B,) insert-call drawn call id
+    slot: jax.Array         # (B,) mutate slot ordinal (-1 = no-op)
+    mut_kind: jax.Array     # (B,) mutate kind (rand/delta/flip)
+    mut_lo: jax.Array       # (B,) mutated value halves (masked)
+    mut_hi: jax.Array
+    n_entries: jax.Array    # (B,) kept segment entries
+
+
+@dataclass
 class SparseUpdateResult:
     has_new: jax.Array          # (B,) device bool — fetch with np.asarray
     new_bits: jax.Array         # (B, MB*block_words) block-LOCAL diffs,
@@ -797,6 +824,8 @@ class CoverageEngine:
         # megakernel via a donated buffer so refills move zero host
         # operands (split off the main chain lazily on first block)
         self._ds_key: "jax.Array | None" = None
+        # the synth megakernel's donated key chain (same pattern)
+        self._synth_key: "jax.Array | None" = None
         self._key_mu = threading.Lock()
         self._state_mu = threading.RLock()
 
@@ -1223,6 +1252,244 @@ class CoverageEngine:
             mc, hn = jax.lax.scan(body, max_cover, (call_ids, pc_idx, counts))
             return mc, hn
 
+        # -- device-resident program synthesis: one dispatch emits a
+        # batch of COMPLETE exec-bytecode programs assembled from the
+        # synth tables (fixed-capacity corpus rows + single-call
+        # template bank, the DeviceKeyMirror growth pattern), edited by
+        # the five host-mutator operators.  Tables/shapes are fixed, so
+        # table growth and operator mix changes move operand contents
+        # only — zero warm recompiles.  The operator spec (index-draw
+        # formulas, truncation rule) is written down in prog/synth.py;
+        # this kernel and prog.synth.HostSynth implement the same text.
+
+        @functools.partial(jax.jit, donate_argnums=(0,),
+                           static_argnums=(21, 22))
+        def _synth(key, prios, enabled, ov_boost, ov_enabled, opw,
+                   rows_lo, rows_hi, call_off, row_ncalls, slot_off,
+                   slot_size, row_nslots, row_cids, t_lo, t_hi, t_len,
+                   call2tmpl, meta, svec, hinc, B, GMAX):
+            R, L = rows_lo.shape
+            CO = call_off.shape[1] - 1
+            A = slot_off.shape[1]
+            Tn, LT = t_lo.shape
+            (key, k_op, k_r, k_k, k_gen, k_cut, k_pos, k_ins, k_mut,
+             k_rnd, k_sq) = jax.random.split(key, 11)
+            nrows = meta[0]
+            have = nrows > 0
+
+            # operator draw (prefix-cdf, like every choice draw here);
+            # an empty corpus forces generate — branch-free via where
+            cdf_op = jnp.cumsum(opw)
+            u_op = jax.random.uniform(k_op, (B,)) * cdf_op[-1]
+            opv = jnp.sum((u_op[:, None] >= cdf_op[None, :])
+                          .astype(jnp.int32), axis=1)
+            op = jnp.where(have, jnp.minimum(opv, 4), 0)
+
+            # corpus row picks: floor(u * nrows) — the written-down
+            # index-draw formula (real uniforms, not modulo)
+            u_r = jax.random.uniform(k_r, (B, 2))
+            den = jnp.maximum(nrows, 1).astype(jnp.float32)
+            r1 = jnp.minimum((u_r[:, 0] * den).astype(jnp.int32),
+                             nrows - 1).clip(0)
+            r2 = jnp.minimum((u_r[:, 1] * den).astype(jnp.int32),
+                             nrows - 1).clip(0)
+            n1 = row_ncalls[r1]
+            n2 = row_ncalls[r2]
+
+            # generate: chained per-context choice draws over calls
+            # that HAVE templates (sample_calls_boosted per step — the
+            # exact decision-stream categorical)
+            has_t = call2tmpl >= 0
+            en_t = jnp.logical_and(jnp.logical_and(enabled, ov_enabled),
+                                   has_t)
+            kcount = 1 + (jax.random.uniform(k_k, (B,))
+                          * GMAX).astype(jnp.int32)
+
+            def gen_step(prev, kk):
+                cid = sample_calls_boosted(kk, prios, prev, en_t,
+                                           ov_boost)
+                return cid, cid
+
+            _, cids = jax.lax.scan(gen_step,
+                                   jnp.full((B,), -1, jnp.int32),
+                                   jax.random.split(k_gen, GMAX))
+            cids = cids.T                       # (B, GMAX)
+            tg = jnp.maximum(call2tmpl[cids], 0)
+            tgp = jnp.concatenate(
+                [tg, jnp.zeros((B, CO - GMAX), jnp.int32)], axis=1) \
+                if CO > GMAX else tg[:, :CO]
+
+            # splice cut / insert position (biased_rand k=5) / squash
+            cut = (jax.random.uniform(k_cut, (B,))
+                   * (n1 + 1).astype(jnp.float32)).astype(jnp.int32)
+            u_pos = jax.random.uniform(k_pos, (B,))
+            pos = jnp.minimum(
+                ((n1 + 1).astype(jnp.float32)
+                 * u_pos ** 0.2).astype(jnp.int32), n1)
+            prev_ins = jnp.where(
+                pos > 0, row_cids[r1, jnp.maximum(pos - 1, 0)], -1)
+            ins_cid = sample_calls_boosted(k_ins, prios, prev_ins,
+                                           en_t, ov_boost)
+            t_ins = jnp.maximum(call2tmpl[ins_cid], 0)
+            u_sq = jax.random.uniform(k_sq, (B,))
+            dele = jnp.where(
+                n1 > 1,
+                (u_sq * n1.astype(jnp.float32)).astype(jnp.int32), -1)
+
+            # per-op entry plans → one branch-free select
+            jj = jnp.arange(CO, dtype=jnp.int32)[None, :]
+            in1 = jj < cut[:, None]
+            in2 = jnp.logical_and(~in1, jj < (cut + n2)[:, None])
+            s_row = jnp.where(in2, r2[:, None], r1[:, None])
+            s_call = jnp.where(in1, jj,
+                               jnp.where(in2, jj - cut[:, None],
+                                         jj - n2[:, None]))
+            s_val = jj < jnp.minimum(n1 + n2, CO)[:, None]
+            at = jj == pos[:, None]
+            i_tbl = jnp.where(at, 1, 0)
+            i_row = jnp.where(at, t_ins[:, None], r1[:, None])
+            i_call = jnp.where(jj < pos[:, None], jj,
+                               jnp.maximum(jj - 1, 0))
+            i_call = jnp.where(at, 0, i_call)
+            i_val = jj < jnp.minimum(n1 + 1, CO)[:, None]
+            d_eff = jnp.where(dele >= 0, dele, CO)[:, None]
+            q_call = jj + (jj >= d_eff).astype(jnp.int32)
+            q_val = jj < jnp.where(n1 > 1, n1 - 1, n1)[:, None]
+            m_val = jj < n1[:, None]
+
+            o = op[:, None]
+
+            def sel(g, s, i, m, q):
+                return jnp.where(
+                    o == 0, g, jnp.where(o == 1, s, jnp.where(
+                        o == 2, i, jnp.where(o == 3, m, q))))
+
+            zero = jnp.zeros((B, CO), jnp.int32)
+            tbl = sel(jnp.ones((B, CO), jnp.int32), zero, i_tbl, zero,
+                      zero)
+            row = sel(tgp, s_row, i_row,
+                      jnp.broadcast_to(r1[:, None], (B, CO)),
+                      jnp.broadcast_to(r1[:, None], (B, CO)))
+            call = sel(zero, s_call, i_call,
+                       jnp.broadcast_to(jj, (B, CO)), q_call)
+            val = sel(jj < kcount[:, None], s_val, i_val, m_val, q_val)
+
+            # segment lengths + the written-down truncation rule: the
+            # longest entry prefix whose words fit L-1 (EOF reserved)
+            rowc = jnp.clip(row, 0, R - 1)
+            rowt = jnp.clip(row, 0, Tn - 1)
+            callc = jnp.clip(call, 0, CO - 1)
+            c_start = call_off[rowc, callc]
+            c_len = call_off[rowc, callc + 1] - c_start
+            is_t = tbl == 1
+            seglen = jnp.where(val, jnp.where(is_t, t_len[rowt], c_len),
+                               0)
+            ends0 = jnp.cumsum(seglen, axis=1)
+            keep = jnp.logical_and(val, ends0 <= L - 1)
+            seglen = jnp.where(keep, seglen, 0)
+            ends = jnp.cumsum(seglen, axis=1)
+            starts = ends - seglen
+            total = ends[:, -1]
+            nkept = keep.sum(axis=1, dtype=jnp.int32)
+            sstart = jnp.where(is_t, 0, c_start)
+
+            # the assembly gather: out word j ← segment e covering j
+            def emit_one(ends_i, starts_i, sstart_i, row_i, ist_i,
+                         total_i):
+                j = jnp.arange(L, dtype=jnp.int32)
+                e = jnp.clip(
+                    jnp.searchsorted(ends_i, j, side="right"), 0,
+                    CO - 1)
+                off = sstart_i[e] + (j - starts_i[e])
+                rc = jnp.clip(row_i[e], 0, R - 1)
+                rt = jnp.clip(row_i[e], 0, Tn - 1)
+                lo = jnp.where(ist_i[e],
+                               t_lo[rt, jnp.clip(off, 0, LT - 1)],
+                               rows_lo[rc, jnp.clip(off, 0, L - 1)])
+                hi = jnp.where(ist_i[e],
+                               t_hi[rt, jnp.clip(off, 0, LT - 1)],
+                               rows_hi[rc, jnp.clip(off, 0, L - 1)])
+                eof = jnp.uint32(0xFFFFFFFF)
+                lo = jnp.where(j < total_i, lo,
+                               jnp.where(j == total_i, eof,
+                                         jnp.uint32(0)))
+                hi = jnp.where(j < total_i, hi,
+                               jnp.where(j == total_i, eof,
+                                         jnp.uint32(0)))
+                return lo, hi
+
+            lo, hi = jax.vmap(emit_one)(ends, starts, sstart, row,
+                                        is_t, total)
+
+            # mutate-arg post-edit: one const value word rewritten
+            u_mut = jax.random.uniform(k_mut, (B, 5))
+            ns = row_nslots[r1]
+            a = (u_mut[:, 0] * jnp.maximum(ns, 1).astype(jnp.float32)
+                 ).astype(jnp.int32)
+            has_slot = jnp.logical_and(op == 3, ns > 0)
+            ac = jnp.clip(a, 0, A - 1)
+            woff = slot_off[r1, ac]
+            sz = slot_size[r1, ac]
+            woffc = jnp.clip(woff, 0, L - 1)
+            old_lo = rows_lo[r1, woffc]
+            old_hi = rows_hi[r1, woffc]
+            kind = (u_mut[:, 1] * 3).astype(jnp.int32)
+            rbits = jax.random.bits(k_rnd, (B, 2), dtype=jnp.uint32)
+            delta = (1 + (u_mut[:, 2] * 16).astype(jnp.int32)
+                     ).astype(jnp.uint32)
+            add_lo = old_lo + delta
+            add_hi = old_hi + (add_lo < old_lo).astype(jnp.uint32)
+            sub_lo = old_lo - delta
+            sub_hi = old_hi - (old_lo < delta).astype(jnp.uint32)
+            sign_pos = u_mut[:, 3] < 0.5
+            d_lo = jnp.where(sign_pos, add_lo, sub_lo)
+            d_hi = jnp.where(sign_pos, add_hi, sub_hi)
+            bit = (u_mut[:, 4] * 64).astype(jnp.uint32)
+            one = jnp.uint32(1)
+            f_lo = old_lo ^ jnp.where(bit < 32,
+                                      jnp.left_shift(one, bit),
+                                      jnp.uint32(0))
+            f_hi = old_hi ^ jnp.where(bit >= 32,
+                                      jnp.left_shift(
+                                          one, bit - jnp.uint32(32)),
+                                      jnp.uint32(0))
+            new_lo = jnp.where(kind == 0, rbits[:, 0],
+                               jnp.where(kind == 1, d_lo, f_lo))
+            new_hi = jnp.where(kind == 0, rbits[:, 1],
+                               jnp.where(kind == 1, d_hi, f_hi))
+            full = jnp.uint32(0xFFFFFFFF)
+            mask_lo = jnp.where(sz >= 4, full,
+                                jnp.left_shift(
+                                    one,
+                                    jnp.clip(8 * sz, 0, 31)
+                                    .astype(jnp.uint32)) - one)
+            hi_bits = jnp.clip(8 * (sz - 4), 0, 31).astype(jnp.uint32)
+            mask_hi = jnp.where(sz <= 4, jnp.uint32(0),
+                                jnp.where(sz >= 8, full,
+                                          jnp.left_shift(one, hi_bits)
+                                          - one))
+            new_lo = new_lo & mask_lo
+            new_hi = new_hi & mask_hi
+            bidx = jnp.arange(B)
+            widx = jnp.where(has_slot, woffc, 0)
+            lo = lo.at[bidx, widx].set(
+                jnp.where(has_slot, new_lo, lo[bidx, widx]))
+            hi = hi.at[bidx, widx].set(
+                jnp.where(has_slot, new_hi, hi[bidx, widx]))
+
+            out32 = jnp.stack([lo, hi], axis=-1).reshape(B, 2 * L)
+            lens32 = (total + 1) * 2
+            if ds is not None:
+                svec = svec + hinc
+                svec = svec.at[ds.slot("synth_batches")].add(1)
+                svec = svec.at[ds.slot("synth_programs")].add(
+                    jnp.int32(B))
+            return (key, out32, lens32, op, r1, r2, cut, pos, dele,
+                    kcount, cids, ins_cid,
+                    jnp.where(has_slot, a, -1), kind, new_lo, new_hi,
+                    nkept, svec)
+
+        self._synth_fn = _synth
         self._random_bits_fn = _random_bits
         self._ingest_update_fn = _ingest_update
         self._ingest_admit_fn = _ingest_admit
@@ -1787,6 +2054,39 @@ class CoverageEngine:
     def random_words(self, n: int) -> np.ndarray:
         return _combine_words(self._random_bits_fn(self._next_key(), n))
 
+    @_locked
+    def synth_block(self, tables: dict, B: int, gen_max: int,
+                    overlay: "DeviceOverlay | None" = None
+                    ) -> SynthBlock:
+        """Dispatch ONE program-synthesis megakernel step (async — the
+        block's fields are device arrays the caller fetches later).
+        `tables` is the fuzzer.synth.DeviceSynth operand dict: fixed-
+        capacity device arrays whose CONTENTS grow (the DeviceKeyMirror
+        pattern), so warm dispatches never recompile.  B/gen_max are
+        static dispatch shapes the caller keeps in a small closed set.
+        The PRNG key is donated (its own chain, like the decision
+        stream's), and the synth stat slots are bumped in-dispatch."""
+        svec, hinc = self._ts_in()
+        ov = overlay if overlay is not None else self._ov_neutral
+        if self._synth_key is None:
+            self._synth_key = self._next_key()
+        t = tables
+        (self._synth_key, out32, lens32, op, r1, r2, cut, pos, dele,
+         k, cids, ins_cid, slot, mkind, mlo, mhi, nkept,
+         svec) = self._synth_fn(
+            self._synth_key, self.prios, self.enabled, ov.boost,
+            ov.enabled, t["op_weights"], t["rows_lo"], t["rows_hi"],
+            t["call_off"], t["ncalls"], t["slot_off"], t["slot_size"],
+            t["nslots"], t["call_ids"], t["t_lo"], t["t_hi"],
+            t["t_len"], t["call2tmpl"], t["meta"], svec, hinc,
+            B, gen_max)
+        self._ts_out(svec)
+        return SynthBlock(out32=out32, lens32=lens32, op=op, r1=r1,
+                          r2=r2, cut=cut, pos=pos, dele=dele, k=k,
+                          gen_cids=cids, ins_cid=ins_cid, slot=slot,
+                          mut_kind=mkind, mut_lo=mlo, mut_hi=mhi,
+                          n_entries=nkept)
+
     # -- introspection ---------------------------------------------------
 
     def cover_counts(self) -> np.ndarray:
@@ -1889,8 +2189,9 @@ class CoverageEngine:
         self.prios = put(np.asarray(state["prios"], np.float32), rep)
         self.enabled = put(np.asarray(state["enabled"], bool), rep)
         # pre-drawn decision state conditioned on the old arrays is
-        # stale; the stream rebuilds its chain lazily off the main key
+        # stale; the streams rebuild their chains lazily off the main key
         self._ds_key = None
+        self._synth_key = None
 
     def adopt_frontiers(self, views: "dict[str, SparseView]") -> None:
         """Carry per-campaign frontier views across an engine swap: the

@@ -36,10 +36,16 @@ import time
 
 import numpy as np
 
+import operator
+
 from syzkaller_tpu.cover import sets
-from syzkaller_tpu.fuzzer.pcmap import DeviceKeyMirror, PcMap
+from syzkaller_tpu.fuzzer.pcmap import DeviceKeyMirror, PcMap, _dedup_rows
 from syzkaller_tpu.utils import log
 from syzkaller_tpu.utils.shapes import pow2_bucket
+
+
+def _u32cover(c) -> np.ndarray:
+    return np.asarray(c, np.uint32).ravel()
 
 
 class DeviceSignal:
@@ -110,25 +116,38 @@ class DeviceSignal:
         owner (source cover index), the shape the fused translate
         kernels consume.  A cover longer than K spreads over several
         rows of the same owner (the legacy chunk semantics — no PC is
-        dropped).  This is a host pack — it serves the LEGACY
-        cover-list entry points; the hot path hands ring views straight
-        through submit_slabs."""
-        maxlen = max((min(len(c), self.K) for c in covers), default=1)
+        dropped).  Fully vectorized (one concat + one scatter — the
+        per-cover Python loops this replaces were audited hotpath
+        remnants); it serves the LEGACY cover-list entry points, the
+        hot path hands ring views straight through submit_slabs."""
+        covs = tuple(map(_u32cover, covers))
+        ncov = len(covs)
+        lens = np.fromiter(map(len, covs), np.int64, ncov)
+        maxlen = min(int(lens.max()), self.K) if ncov else 1
         K = pow2_bucket(max(maxlen, 8), 8, self.K)
-        nrows = sum(max(1, -(-len(c) // K)) for c in covers)
-        B = pow2_bucket(max(nrows, 1), 1, 1 << 16)
+        nch = np.maximum(1, -(-lens // K)) if ncov else \
+            np.zeros(0, np.int64)
+        rows = int(nch.sum())
+        B = pow2_bucket(max(rows, 1), 1, 1 << 16)
         win = np.zeros((B, K), np.uint32)
         counts = np.zeros((B,), np.int32)
         owner = np.full((B,), -1, np.int32)
-        r = 0
-        for i, c in enumerate(covers):
-            c = np.asarray(c, np.uint32)
-            for lo in range(0, max(len(c), 1), K):
-                seg = c[lo: lo + K]
-                win[r, : len(seg)] = seg
-                counts[r] = len(seg)
-                owner[r] = i
-                r += 1
+        if ncov == 0:
+            return win, counts, owner
+        row_start = np.cumsum(nch) - nch
+        rcov = np.repeat(np.arange(ncov), nch)
+        rchunk = np.arange(rows) - np.repeat(row_start, nch)
+        counts[:rows] = np.clip(lens[rcov] - rchunk * K, 0, K)
+        owner[:rows] = rcov
+        total = int(lens.sum())
+        if total:
+            flat = np.concatenate(covs)
+            cover_id = np.repeat(np.arange(ncov), lens)
+            pos = np.arange(total) - np.repeat(np.cumsum(lens) - lens,
+                                               lens)
+            r = row_start[cover_id] + pos // K
+            c = pos % K
+            win[r, c] = flat
         return win, counts, owner
 
     # -- hot path ----------------------------------------------------------
@@ -171,10 +190,20 @@ class DeviceSignal:
         dispatch; re-merging is idempotent, and the two has_new halves
         OR (a new-key PC is by definition new signal)."""
         rows = np.nonzero(miss)[0]
-        covers = [np.asarray(win[i, : counts[i]], np.uint64) for i in rows]
+        K = win.shape[1]
+        sub = np.asarray(win)[rows].astype(np.uint64)
+        cnts = np.asarray(counts)[rows]
+        inmask = np.arange(K)[None, :] < cnts[:, None]
         before = len(self.pcmap)
-        idx, valid, _owner = self.pcmap.map_rows(covers, win.shape[1])
+        # row-major masked flatten preserves occurrence order, so
+        # first-seen insertion order (export_keys/snapshots) is exactly
+        # the legacy per-row map_rows semantics — vectorized
+        vals = self.pcmap.map_flat(sub[inmask])
         added = len(self.pcmap) - before
+        idx = np.zeros((len(rows), K), np.int32)
+        idx[inmask] = vals
+        valid = inmask.copy()
+        _dedup_rows(idx, valid)
         if added and self.tstats is not None:
             self.tstats.inc("ingest_new_keys", added)
         self.mirror.refresh()
@@ -205,20 +234,24 @@ class DeviceSignal:
         kernels (one host pack, zero host translation); word-block-
         sparse configs keep the legacy host-mapped path — their sparse
         fast path needs host-computed touched blocks."""
+        # vectorized unpack of the (call_id, cover) entry list: the
+        # canonicalize map + one id vector — the per-entry list
+        # comprehensions this replaces were audited hotpath remnants
+        covers = tuple(map(sets.canonicalize,
+                           map(operator.itemgetter(1), entries)))
+        entry_ids = np.fromiter(map(operator.itemgetter(0), entries),
+                                np.int32, len(entries))
         if self._slab_hot_path:
-            covers = [sets.canonicalize(cov) for _, cov in entries]
             win, counts, owner = self._slabify(covers)
             call_ids = np.zeros((win.shape[0],), np.int32)
             m = owner >= 0
-            call_ids[m] = np.array([entries[o][0] for o in owner[m]],
-                                   np.int32)
+            call_ids[m] = entry_ids[owner[m]]
             ticket = self.submit_slabs(win, counts, call_ids)
             return ("wrap", ticket, owner, len(entries))
-        covers = [sets.canonicalize(cov) for _, cov in entries]
         idx, valid, owner = self._map_rows(covers)
         call_ids = np.zeros((idx.shape[0],), np.int32)
         m = owner >= 0
-        call_ids[m] = np.array([entries[o][0] for o in owner[m]], np.int32)
+        call_ids[m] = entry_ids[owner[m]]
         # sparse when configured and the batch's footprint fits; the
         # engine falls back to the dense step with identical verdicts
         res = self.engine.update_batch_sparse(call_ids, idx, valid)

@@ -93,10 +93,17 @@ class _RingIngest:
         self._last_dropped = 0
 
     def note_exec(self, prog: "M.Prog | None") -> None:
-        from syzkaller_tpu.ipc import ring as ring_mod
         ids = _exec_call_ids(prog) if prog is not None else None
+        self.note_exec_ids(ids, prog)
+
+    def note_exec_ids(self, ids, owner) -> None:
+        """Watermark one exec with a pre-computed call-id vector.
+        `owner` is a Prog, a zero-arg Prog factory (device-synthesized
+        programs materialize lazily — only new-signal slabs pay the
+        provenance replay), or None (slabs discarded)."""
+        from syzkaller_tpu.ipc import ring as ring_mod
         self._marks.append(
-            (prog, ids, self.reader.ring.load(ring_mod.H_RESV)))
+            (owner, ids, self.reader.ring.load(ring_mod.H_RESV)))
 
     def on_restart(self) -> None:
         """The executor died (hang/kill/retry): drain the committed
@@ -186,11 +193,13 @@ class _RingIngest:
         has_new = self.f.signal.resolve(ticket)
         items = []
         for i in np.nonzero(has_new[: batch.n])[0]:
-            # cover materializes ONLY for new-signal slabs — the rare
-            # path that feeds the triage queue
-            if owners[i] is not None:
+            # cover (and, for synth programs, the Prog itself via the
+            # provenance-replay factory) materializes ONLY for
+            # new-signal slabs — the rare path feeding triage
+            own = owners[i]
+            if own is not None:
                 items.append(TriageItem(
-                    prog=M.clone_prog(owners[i]),
+                    prog=own() if callable(own) else M.clone_prog(own),
                     call_index=int(batch.tags[i]),
                     cover=batch.cover(i)))
         self.reader.consume(batch)
@@ -212,7 +221,7 @@ class Fuzzer:
                  output_mode: str = "none", leak: bool = False,
                  table=None, seed: int = 0, use_device: bool = False,
                  npcs: int = 1 << 16, flush_batch: int = 32,
-                 corpus_cap: int = 1 << 14):
+                 corpus_cap: int = 1 << 14, synth: bool = False):
         self.name = name
         self.procs = procs
         self.output_mode = output_mode
@@ -296,6 +305,12 @@ class Fuzzer:
         # per-env zero-copy ring ingests (keyed by env identity; each
         # proc owns one env + one ring)
         self._ingests: dict[int, _RingIngest] = {}
+        # device program synthesis: the synth tables are shared across
+        # procs (built in build_call_list once the enabled set is
+        # known); each proc runs its own SynthStream over its own
+        # program ring.  Requires the device signal plane.
+        self._synth_requested = synth and self.signal is not None
+        self.synthdev = None
 
         n = self.table.count
         self.max_cover: list[np.ndarray] = [np.zeros(0, np.uint32)] * n
@@ -375,6 +390,20 @@ class Fuzzer:
             self.signal.engine.set_enabled(self.enabled_ids)
             self.ct = DeviceChoiceTable(self.signal.engine,
                                         telemetry=self.signal.tstats)
+            if self._synth_requested:
+                # device program synthesis: pre-encode one template per
+                # enabled call (the eligibility gate filters); corpus
+                # rows grow from triage admissions (add_program)
+                from syzkaller_tpu.fuzzer.synth import DeviceSynth
+                self.synthdev = DeviceSynth(
+                    self.signal.engine, self.table,
+                    telemetry=self.signal.tstats)
+                trand = P.Rand(np.random.default_rng(
+                    self.seed * 131 + 17))
+                nt = self.synthdev.build_templates(self.enabled_ids,
+                                                   trand)
+                log.logf(0, "synth templates: %d/%d calls eligible",
+                         nt, len(self.enabled_ids))
         else:
             self.ct = P.ChoiceTable(prios, set(self.enabled_ids),
                                     ncalls=self.table.count)
@@ -509,6 +538,50 @@ class Fuzzer:
                     ingest.on_restart()
                 time.sleep(0.5 * (attempt + 1))
         return None
+
+    def execute_synth(self, env: ipc.Env, entry, pid: int) -> None:
+        """Run one device-synthesized program: ringed entries take the
+        slab-attach path (the program never crosses shm-in), ring-full
+        entries fall back to shm bytes (the slab IS the wire image).
+        Per exec the host does O(1) work: the exec request, one
+        watermark note, and — only on failure — the reverse-direction
+        ring resync (skip the slab a dead executor never consumed)."""
+        from syzkaller_tpu.ipc import ring as ring_mod
+        sp, ringed = entry
+        if self.output_mode == "stdout":
+            # crash-attribution invariant: text precedes execution —
+            # the one synth path that pays a materialize per exec
+            self.log_program(pid, sp.materialize())
+        self._stat_counters["exec total"].inc()
+        self._stat_counters["exec fuzz"].inc()
+        ingest = self._ingests.get(id(env))
+        cons0 = (env.prog_ring.load(ring_mod.H_CONSUMED)
+                 if ringed and env.prog_ring is not None else -1)
+        try:
+            t0 = time.monotonic()
+            if ringed:
+                res = env.exec(None, from_prog_ring=True,
+                               parse_covers=ingest is None)
+            else:
+                res = env.exec(sp.exec_bytes(),
+                               parse_covers=ingest is None)
+            self._h_exec.observe(time.monotonic() - t0)
+        except ipc.ExecutorFailure as e:
+            log.logf(0, "synth exec failure: %s", e)
+            res = None
+        if ingest is not None:
+            if res is not None:
+                ingest.note_exec_ids(sp.call_ids(), sp.materialize)
+            else:
+                ingest.note_exec(None)
+            if res is None or res.restarted or res.hanged:
+                ingest.on_restart()
+        if ringed and env.prog_ring is not None and (
+                res is None or res.hanged or res.status < 0):
+            # the executor died without replying: if it never consumed
+            # the slab, skip it so the next ringed exec reads its own
+            if env.prog_ring.load(ring_mod.H_CONSUMED) == cons0:
+                ring_mod.skip_committed(env.prog_ring, 1)
 
     def check_new_signal(self, p: M.Prog, res: ipc.ExecResult) -> None:
         if self.signal is not None:
@@ -647,6 +720,11 @@ class Fuzzer:
                 # program even after chunked/full-matrix admissions
                 self.signal.merge_corpus(cid, min_cover,
                                          corpus_index=len(self.corpus) - 1)
+        if self.synthdev is not None:
+            # synth-table growth (the host fix-up → append loop):
+            # triaged programs that satisfy the segment contract join
+            # the device corpus; the rest stay host-side
+            self.synthdev.add_program(item.prog)
         self._stat_counters["new inputs"].inc()
         span.add_hop("fuzzer:triage+minimize", time.monotonic() - t_triage)
         if self._shed_active():
@@ -733,11 +811,19 @@ class Fuzzer:
         # the word-block-sparse path needs host-computed blocks)
         use_ring = (self.signal is not None
                     and getattr(self.signal, "_slab_hot_path", False))
-        env = ipc.Env(flags=self.flags, pid=pid, ring=use_ring)
+        use_synth = self.synthdev is not None
+        env = ipc.Env(flags=self.flags, pid=pid, ring=use_ring,
+                      prog_ring=use_synth)
         ingest = None
         if use_ring and env.ring is not None:
             ingest = _RingIngest(self, env)
             self._ingests[id(env)] = ingest
+        synth_stream = None
+        if use_synth:
+            from syzkaller_tpu.fuzzer.synth import SynthStream
+            synth_stream = SynthStream(
+                self.synthdev,
+                ring_writer=getattr(env, "prog_writer", None))
         gate = self.gate
         try:
             while not self._stop:
@@ -759,6 +845,21 @@ class Fuzzer:
                     if ingest is not None:
                         ingest.maybe_flush()
                     continue
+                if synth_stream is not None and self.campaign is None:
+                    # the device-resident exec pipeline: program
+                    # assembly happened on device (synth_block), the
+                    # slab is already in the program ring, covers come
+                    # back through the PC ring — O(1) host dispatches
+                    # per exec in BOTH directions.  An underrun (no
+                    # templates yet / dispatch failure) falls through
+                    # to the host generator below, counted.
+                    entry = synth_stream.next_program()
+                    if entry is not None:
+                        with gate.section():
+                            self.execute_synth(env, entry, pid)
+                        if ingest is not None:
+                            ingest.maybe_flush()
+                        continue
                 with self._mu:
                     corpus = list(self.corpus)
                     choice = (self.device_choices.popleft()
@@ -923,7 +1024,11 @@ class Fuzzer:
             delta, self._ts_shipped = vals - self._ts_shipped, vals
             for key, wire in (("dense_batches", "cover dense dispatches"),
                               ("sparse_batches", "cover sparse dispatches"),
-                              ("sparse_fallback", "cover sparse fallbacks")):
+                              ("sparse_fallback", "cover sparse fallbacks"),
+                              ("synth_batches", "synth dispatches"),
+                              ("synth_programs", "synth programs"),
+                              ("synth_slabs", "synth ring slabs"),
+                              ("synth_underrun", "synth underruns")):
                 d = int(delta[ds.slot(key)])
                 if d:
                     stats[wire] = d
@@ -1051,6 +1156,10 @@ def main(argv=None):
     ap.add_argument("-seed", type=int, default=0)
     ap.add_argument("-device", action="store_true",
                     help="run signal diffs/sampling on the JAX device")
+    ap.add_argument("-synth", action="store_true",
+                    help="device-resident program synthesis: assemble "
+                         "exec bytecode on device, feed the executor "
+                         "through the program slab ring (needs -device)")
     ap.add_argument("-npcs", type=int, default=1 << 16)
     ap.add_argument("-flush-batch", type=int, default=32, dest="flush_batch")
     ap.add_argument("-corpus-cap", type=int, default=1 << 14,
@@ -1075,7 +1184,8 @@ def main(argv=None):
                descriptions=args.descriptions, flags=flags,
                output_mode=args.output, leak=args.leak, seed=args.seed,
                use_device=args.device, npcs=args.npcs,
-               flush_batch=args.flush_batch, corpus_cap=args.corpus_cap)
+               flush_batch=args.flush_batch, corpus_cap=args.corpus_cap,
+               synth=args.synth)
 
     def on_sigint(sig, frame):
         # GCE preemption path (ref fuzzer.go:102-109, vm/vm.go:118-120)

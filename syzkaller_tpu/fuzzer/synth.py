@@ -1,0 +1,401 @@
+"""Device-resident program synthesis: the fuzzer-side table owner and
+the prefetching program stream over `engine.synth_block`.
+
+`DeviceSynth` owns the synth tables — a fixed-capacity corpus of
+pre-encoded programs plus a single-call template bank — as host numpy
+canonicals mirrored into fixed-shape device operands (the
+`DeviceKeyMirror` growth pattern: capacity is allocated once, growth
+rewrites CONTENTS, a dispatch signature never changes, so table growth
+costs zero warm recompiles).  Growth follows the miss→host-fix-up→
+append loop: programs the triage plane admits are host-encoded through
+the `prog.synth.encode_program` eligibility gate (segment contract +
+decode/csource round trip) and appended; ineligible programs simply
+stay on the host path.
+
+`SynthStream` is the proc loop's consumer plane: a submit/resolve
+pipeline (dispatch block N+1, resolve N — the `_RingIngest` pattern)
+that turns each resolved block into a queue of ready-to-exec programs,
+writes their slabs into the device→executor program ring in one
+vectorized batch, and hands the proc loop O(1) work per exec: pop an
+entry, fire the exec request, note the watermark.  Programs
+materialize to `M.Prog` ONLY on the rare paths that need them (triage
+items, crash logging) via provenance replay — `prog.synth.materialize`
+reconstructs the exact program whose `serialize_for_exec` equals the
+slab bit for bit.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+
+import numpy as np
+
+from syzkaller_tpu.prog import model as M
+from syzkaller_tpu.prog import synth as PS
+from syzkaller_tpu.utils import log
+
+
+class DeviceSynth:
+    """Synth table owner + megakernel dispatcher (thread-safe)."""
+
+    def __init__(self, engine, table, rows_cap: int = 128,
+                 tmpl_cap: int = 128, max_words: int = 192,
+                 max_calls: int = 12, max_slots: int = 32,
+                 tmpl_words: int = 96, gen_max: int = 6,
+                 batch: int = 64, telemetry=None):
+        self.engine = engine
+        self.table = table
+        self.tstats = telemetry if telemetry is not None else engine.tstats
+        self.R = rows_cap
+        self.T = tmpl_cap
+        self.L = max_words
+        self.CO = max_calls
+        self.A = max_slots
+        self.LT = tmpl_words
+        self.GMAX = min(gen_max, max_calls)
+        self.B = batch
+        C = engine.ncalls
+        self._mu = threading.Lock()
+        # host canonicals (fixed capacity; contents grow)
+        self._rows: list[PS.EncodedProgram] = []
+        self._tmpls: list[PS.EncodedProgram] = []
+        self._h = {
+            "rows_lo": np.zeros((self.R, self.L), np.uint32),
+            "rows_hi": np.zeros((self.R, self.L), np.uint32),
+            "call_off": np.zeros((self.R, self.CO + 1), np.int32),
+            "ncalls": np.ones((self.R,), np.int32),
+            "slot_off": np.zeros((self.R, self.A), np.int32),
+            "slot_size": np.full((self.R, self.A), 8, np.int32),
+            "nslots": np.zeros((self.R,), np.int32),
+            "call_ids": np.zeros((self.R, self.CO), np.int32),
+            "t_lo": np.zeros((self.T, self.LT), np.uint32),
+            "t_hi": np.zeros((self.T, self.LT), np.uint32),
+            "t_len": np.zeros((self.T,), np.int32),
+            "call2tmpl": np.full((C,), -1, np.int32),
+            "meta": np.zeros((2,), np.int32),
+            "op_weights": PS.OPERATOR_WEIGHTS.astype(np.float32),
+        }
+        self._dev: "dict | None" = None
+        self.stat_rows_rejected = 0
+        self.stat_tmpl_rejected = 0
+
+    # -- growth (host fix-up → incremental append) -----------------------
+
+    def build_templates(self, enabled_ids, rand, tries: int = 3) -> int:
+        """Populate the template bank: one eligible single-call
+        pre-encoding per enabled call (retried — generation is random,
+        a call can draw an ineligible instance first).  Returns the
+        bank size."""
+        from syzkaller_tpu.prog.analysis import State
+        from syzkaller_tpu.prog.rand import Gen
+
+        for cid in enabled_ids:
+            meta = self.table.calls[cid]
+            for _ in range(tries):
+                state = State(self.table)
+                gen = Gen(rand, state, self.table, None)
+                try:
+                    calls = gen.generate_particular_call(meta)
+                except Exception:
+                    continue
+                if self._admit_template(cid, M.Prog(calls=calls)):
+                    break
+            else:
+                self.stat_tmpl_rejected += 1
+        return len(self._tmpls)
+
+    def _admit_template(self, cid: int, p: M.Prog) -> bool:
+        enc = PS.encode_program(p, self.table)
+        if enc is None or enc.nwords == 0 or enc.nwords > self.LT:
+            return False
+        with self._mu:
+            if len(self._tmpls) >= self.T:
+                return False
+            t = len(self._tmpls)
+            self._tmpls.append(enc)
+            h = self._h
+            w = enc.words
+            h["t_lo"][t, : len(w)] = (w & np.uint64(0xFFFFFFFF)
+                                      ).astype(np.uint32)
+            h["t_hi"][t, : len(w)] = (w >> np.uint64(32)
+                                      ).astype(np.uint32)
+            h["t_len"][t] = len(w)
+            h["call2tmpl"][cid] = t
+            h["meta"][1] = len(self._tmpls)
+            self._dev = None
+        return True
+
+    def add_program(self, p: M.Prog) -> bool:
+        """Admit a triaged program into the device corpus table (the
+        growth loop's host fix-up).  Rows replace FIFO once the table
+        is full — replacement rewrites contents, never shapes.
+        Returns False for ineligible programs (they stay host-side)."""
+        enc = PS.encode_program(p, self.table)
+        if enc is None or enc.nwords == 0 or enc.nwords > self.L - 1 \
+                or enc.ncalls > self.CO or len(enc.slots) > self.A:
+            self.stat_rows_rejected += 1
+            return False
+        with self._mu:
+            if len(self._rows) < self.R:
+                r = len(self._rows)
+                self._rows.append(enc)
+            else:
+                r = int(self._h["meta"][0]) % self.R
+                self._rows[r] = enc
+            h = self._h
+            w = enc.words
+            h["rows_lo"][r] = 0
+            h["rows_hi"][r] = 0
+            h["rows_lo"][r, : len(w)] = (w & np.uint64(0xFFFFFFFF)
+                                         ).astype(np.uint32)
+            h["rows_hi"][r, : len(w)] = (w >> np.uint64(32)
+                                         ).astype(np.uint32)
+            off = np.full((self.CO + 1,), enc.nwords, np.int32)
+            off[: len(enc.call_off)] = enc.call_off
+            h["call_off"][r] = off
+            h["ncalls"][r] = enc.ncalls
+            h["call_ids"][r] = 0
+            h["call_ids"][r, : enc.ncalls] = enc.call_ids
+            h["nslots"][r] = len(enc.slots)
+            h["slot_off"][r] = 0
+            h["slot_size"][r] = 8
+            for a, (woff, size, _ci) in enumerate(enc.slots):
+                h["slot_off"][r, a] = woff
+                h["slot_size"][r, a] = size
+            h["meta"][0] = max(int(h["meta"][0]), len(self._rows))
+            self._dev = None
+        if self.tstats is not None:
+            self.tstats.inc("synth_table_rows")
+        return True
+
+    def operands(self) -> dict:
+        """Fixed-shape device operands, re-put only after growth."""
+        with self._mu:
+            if self._dev is None:
+                put = self.engine.put_replicated
+                self._dev = {k: put(v) for k, v in self._h.items()}
+            return self._dev
+
+    def invalidate_device(self) -> None:
+        """Drop cached device operands (backend failover re-homes)."""
+        with self._mu:
+            self._dev = None
+
+    def snapshot(self):
+        """Immutable table snapshot for provenance replay: dispatches
+        resolve against the tables AS OF submit time, so a FIFO row
+        replacement racing a resolve cannot misattribute."""
+        with self._mu:
+            return tuple(self._rows), tuple(self._tmpls)
+
+    @property
+    def n_rows(self) -> int:
+        with self._mu:
+            return len(self._rows)
+
+    @property
+    def n_templates(self) -> int:
+        with self._mu:
+            return len(self._tmpls)
+
+    # -- dispatch --------------------------------------------------------
+
+    def dispatch(self, overlay=None):
+        """One async synth_block dispatch; returns an opaque ticket."""
+        blk = self.engine.synth_block(self.operands(), self.B,
+                                      self.GMAX, overlay=overlay)
+        return (blk, self.snapshot(), time.monotonic())
+
+    def resolve(self, ticket) -> "SynthBatch":
+        """Fetch one dispatched block: B ready programs as one slab
+        matrix plus per-program provenance views (call ids and Prog
+        factories derive lazily from provenance + the submit-time
+        table snapshot)."""
+        blk, (rows, tmpls), t0 = ticket
+        out32 = np.asarray(blk.out32)
+        lens32 = np.asarray(blk.lens32)
+        op = np.asarray(blk.op)
+        r1 = np.asarray(blk.r1)
+        r2 = np.asarray(blk.r2)
+        cut = np.asarray(blk.cut)
+        pos = np.asarray(blk.pos)
+        dele = np.asarray(blk.dele)
+        k = np.asarray(blk.k)
+        gen_cids = np.asarray(blk.gen_cids)
+        ins_cid = np.asarray(blk.ins_cid)
+        slot = np.asarray(blk.slot)
+        mkind = np.asarray(blk.mut_kind)
+        mval = (np.asarray(blk.mut_hi).astype(np.uint64) << np.uint64(32)
+                ) | np.asarray(blk.mut_lo).astype(np.uint64)
+        nent = np.asarray(blk.n_entries)
+        if self.tstats is not None:
+            self.tstats.observe("synth_block_consume_latency",
+                                time.monotonic() - t0)
+        c2t = self._h["call2tmpl"]
+        gen_tmpls = np.maximum(c2t[gen_cids], 0)
+        ins_tmpl = np.maximum(c2t[ins_cid], 0)
+        progs = []
+        for i in range(len(op)):
+            prov = PS.Provenance(
+                op=int(op[i]), r1=int(r1[i]), r2=int(r2[i]),
+                cut=int(cut[i]), pos=int(pos[i]), dele=int(dele[i]),
+                k=int(k[i]),
+                gen_tmpls=tuple(gen_tmpls[i][: int(k[i])].tolist()),
+                ins_tmpl=int(ins_tmpl[i]),
+                slot=int(slot[i]), mut_kind=int(mkind[i]),
+                mut_val=int(mval[i]), n_entries=int(nent[i]))
+            progs.append(SynthProgram(
+                self, prov, rows, tmpls, out32[i], int(lens32[i])))
+        return SynthBatch(out32=out32, lens32=lens32, progs=progs)
+
+
+class SynthBatch:
+    """One resolved synth block: the slab matrix (ring write operand)
+    + per-program handles (views into it)."""
+
+    __slots__ = ("out32", "lens32", "progs")
+
+    def __init__(self, out32, lens32, progs):
+        self.out32 = out32
+        self.lens32 = lens32
+        self.progs = progs
+
+
+class SynthProgram:
+    """One device-synthesized program: slab words now, Prog on demand."""
+
+    __slots__ = ("synth", "prov", "rows", "tmpls", "words32", "len32",
+                 "_ids")
+
+    def __init__(self, synth, prov, rows, tmpls, words32, len32):
+        self.synth = synth
+        self.prov = prov
+        self.rows = rows
+        self.tmpls = tmpls
+        self.words32 = words32
+        self.len32 = len32
+        self._ids = None
+
+    def exec_bytes(self) -> bytes:
+        """The exec wire image (shm fallback path when the program
+        ring is full): the slab IS the wire format."""
+        return self.words32[: self.len32].tobytes()
+
+    def call_ids(self) -> np.ndarray:
+        """Per-call table ids, derived from the segment plan (no Prog
+        materialization): slab tag → call id for ring attribution."""
+        if self._ids is None:
+            # bounded by max_calls (CO) entries — not data-proportional
+            ent = PS.plan_entries(self.prov, self.rows, self.tmpls,
+                                  self.synth.L, self.synth.CO)
+            parts = tuple(
+                (self.tmpls[idx].call_ids if tbl
+                 else self.rows[idx].call_ids[call: call + 1])
+                for tbl, idx, call in ent)
+            self._ids = (np.concatenate(parts).astype(np.int32)
+                         if parts else np.zeros(0, np.int32))
+        return self._ids
+
+    def materialize(self) -> M.Prog:
+        """Provenance replay → the exact M.Prog whose exec encoding is
+        this slab (rare path: triage items, crash logging)."""
+        return PS.materialize(self.prov, self.rows, self.tmpls,
+                              self.synth.L, self.synth.CO)
+
+
+class SynthStream:
+    """The proc loop's program source: pipelined dispatch + ring write.
+
+    `next_program()` is the per-exec entry point: a deque pop.  When
+    the queue drains below B the stream dispatches a new block and
+    resolves the previously in-flight one (double-buffered, so the
+    device round trip overlaps executor work).  Resolved programs are
+    written to the device→executor program ring in ONE vectorized
+    batch; entries that could not be ringed (ring full — counted)
+    carry their bytes for the shm fallback path."""
+
+    def __init__(self, synth: DeviceSynth, ring_writer=None,
+                 max_queue: "int | None" = None):
+        self.synth = synth
+        self.writer = ring_writer       # ipc.ring.RingWriter | None
+        self._q: deque[tuple] = deque()   # (SynthProgram, ringed)
+        self._inflight = None
+        self._mu = threading.Lock()
+        self.max_queue = max_queue or 4 * synth.B
+        self.stat_served = 0
+        self.stat_ring_written = 0
+        self.stat_ring_full = 0
+        self.stat_underruns = 0
+
+    def ready(self) -> bool:
+        return self.synth.n_templates > 0
+
+    def next_program(self) -> "tuple | None":
+        """(SynthProgram, ringed) or None when the plane cannot serve
+        (no templates yet / dispatch failure) — the caller falls back
+        to host generation, counted as an underrun."""
+        with self._mu:
+            if self._q:
+                self.stat_served += 1
+                return self._q.popleft()
+        if not self.ready():
+            return None
+        try:
+            self._refill()
+        except Exception as e:
+            log.logf(0, "synth refill failed: %r", e)
+            self._note_underrun()
+            return None
+        with self._mu:
+            if self._q:
+                self.stat_served += 1
+                return self._q.popleft()
+        self._note_underrun()
+        return None
+
+    def _note_underrun(self) -> None:
+        self.stat_underruns += 1
+        if self.synth.tstats is not None:
+            self.synth.tstats.inc("synth_underrun")
+
+    def _refill(self) -> None:
+        """Dispatch a fresh block, then resolve the previous one into
+        the queue (submit-N+1-resolve-N pipelining).  The FIRST refill
+        resolves synchronously so the caller gets programs now."""
+        with self._mu:
+            prev, self._inflight = self._inflight, None
+        nxt = self.synth.dispatch()
+        if prev is None:
+            self._publish(self.synth.resolve(nxt))
+            return
+        with self._mu:
+            self._inflight = nxt
+        self._publish(self.synth.resolve(prev))
+
+    def _publish(self, batch) -> None:
+        ringed = self._write_ring(batch)
+        with self._mu:
+            if len(self._q) < self.max_queue:
+                self._q.extend(zip(batch.progs, ringed))
+
+    def _write_ring(self, batch) -> np.ndarray:
+        """One vectorized ring write per block — the resolved slab
+        matrix IS the write operand (same-bucket slabs land as one
+        contiguous block copy); a full ring degrades those entries to
+        per-entry shm bytes.  Returns the (B,) written-mask."""
+        n = len(batch.progs)
+        if self.writer is None:
+            return np.zeros((n,), bool)
+        ok = self.writer.write_batch(batch.out32, batch.lens32)
+        wrote = int(np.sum(ok))
+        self.stat_ring_written += wrote
+        self.stat_ring_full += n - wrote
+        ts = self.synth.tstats
+        if ts is not None:
+            if wrote:
+                ts.inc("synth_slabs", wrote)
+            if wrote < n:
+                ts.inc("synth_ring_full", n - wrote)
+        return ok

@@ -40,6 +40,17 @@ FLAG_SANDBOX_NAMESPACE = 1 << 6
 FLAG_FAKE_COVER = 1 << 7
 FLAG_ENABLE_TUN = 1 << 8
 FLAG_RING_SKIP = 1 << 9   # don't write this exec's covers to the PC ring
+FLAG_PROG_RING = 1 << 10  # read the program from the program slab ring
+#                           instead of shm-in (device→executor path)
+
+# program ring geometry: one program slab = a whole exec image in u32
+# words (u64 wire words as lo/hi pairs).  min_bucket spans the synth
+# plane's program cap so every slab shares ONE bucket — a synth batch
+# is a single contiguous vectorized write.
+PROG_RING_DATA_WORDS = 1 << 18          # 1MB of program slabs
+PROG_RING_INDEX_SLOTS = 1 << 10
+PROG_RING_SLAB_CAP = 4096               # u32 words = 16KB program cap
+PROG_RING_MIN_BUCKET = 512
 
 # executor exit statuses (ref common.h:46-48)
 STATUS_OK = 0
@@ -86,7 +97,7 @@ class Env:
     def __init__(self, flags: int = FLAG_COVER | FLAG_DEDUP_COVER,
                  pid: int = 0, executor: "str | None" = None,
                  workdir: "str | None" = None, timeout: float = 10.0,
-                 ring: bool = False):
+                 ring: bool = False, prog_ring: bool = False):
         self.flags = flags
         self.pid = pid
         self.timeout = timeout
@@ -116,6 +127,21 @@ class Env:
             self.ring = ring_mod.PcRing.create(self._ring_file,
                                                min_bucket=64)
             self.ring_reader = ring_mod.RingReader(self.ring)
+        # device→executor program slab ring: the executor reads whole
+        # exec images straight off shared memory (FLAG_PROG_RING execs
+        # skip the shm-in program write entirely); one bucket spans a
+        # program so synth batches land as one contiguous write
+        self.prog_ring = None
+        if prog_ring:
+            from syzkaller_tpu.ipc import ring as ring_mod
+            self._prog_ring_file = os.path.join(self.workdir,
+                                                f"shm-prog-{pid}")
+            self.prog_ring = ring_mod.PcRing.create(
+                self._prog_ring_file, data_words=PROG_RING_DATA_WORDS,
+                index_slots=PROG_RING_INDEX_SLOTS,
+                slab_cap=PROG_RING_SLAB_CAP,
+                min_bucket=PROG_RING_MIN_BUCKET)
+            self.prog_writer = ring_mod.RingWriter(self.prog_ring)
         self._open_shm()
 
     def _open_shm(self) -> None:
@@ -144,10 +170,17 @@ class Env:
         # fd numbers go via argv: subprocess keeps pass_fds at their
         # original numbers (dup2-in-preexec would be undone by close_fds).
         fds = (self._in_fd, self._out_fd, req_r, rep_w)
+        argv = [*map(str, fds)]
         if self.ring is not None:
             fds = fds + (self.ring.fd,)
+            argv.append(str(self.ring.fd))
+        elif self.prog_ring is not None:
+            argv.append("-1")               # no PC ring, argv slot kept
+        if self.prog_ring is not None:
+            fds = fds + (self.prog_ring.fd,)
+            argv.append(str(self.prog_ring.fd))
         return subprocess.Popen(
-            [self.executor, *map(str, fds)],
+            [self.executor, *argv],
             pass_fds=fds,
             stdin=subprocess.DEVNULL,
             stdout=subprocess.DEVNULL,
@@ -191,6 +224,8 @@ class Env:
                 pass
         if self.ring is not None:
             self.ring.close()
+        if self.prog_ring is not None:
+            self.prog_ring.close()
 
     def ring_resync(self) -> int:
         """Skip any torn (reserved-uncommitted) slab the executor left
@@ -202,8 +237,9 @@ class Env:
 
     # -- execution ---------------------------------------------------------
 
-    def exec(self, p: "M.Prog | bytes", parse_covers: bool = True,
-             extra_flags: int = 0) -> ExecResult:
+    def exec(self, p: "M.Prog | bytes | None", parse_covers: bool = True,
+             extra_flags: int = 0,
+             from_prog_ring: bool = False) -> ExecResult:
         """Run one program; relaunches the executor transparently on
         hang/retryable failure (ref ipc.go:206-218).
 
@@ -213,9 +249,23 @@ class Env:
         copying them here would pay the host packing twice.
         extra_flags ORs per-exec flag bits into the request header
         (FLAG_RING_SKIP keeps triage/minimize re-executions out of the
-        slab ring, so hot-loop attribution stays 1:1)."""
+        slab ring, so hot-loop attribution stays 1:1).
+
+        from_prog_ring=True is the device→executor slab-attach path:
+        the program was already committed to the program ring (one
+        vectorized batch write), so nothing is copied into shm-in —
+        the executor reads the next committed slab straight off the
+        shared mapping and consumes it after the run.  `p` may be None
+        then (a serialized fallback is not required)."""
         self._parse_covers = parse_covers
-        data = p if isinstance(p, bytes) else serialize_for_exec(p, self.pid)
+        if from_prog_ring:
+            if self.prog_ring is None:
+                raise ExecutorFailure("no program ring attached")
+            data = b""
+            extra_flags |= FLAG_PROG_RING
+        else:
+            data = p if isinstance(p, bytes) \
+                else serialize_for_exec(p, self.pid)
         res = ExecResult()
         if self._proc is None or self._proc.poll() is not None:
             self._kill()

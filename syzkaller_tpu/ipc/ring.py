@@ -50,6 +50,20 @@ field landed before the reservation was published), counts it in
 `skipped_uncommitted`, and the ring keeps flowing — crash-only, like
 the rest of the plane.  Ring-full is a counted drop (`dropped_full`),
 never a blocked executor.
+
+The ring is bidirectional by construction — single writer, single
+reader, direction-agnostic.  The PROGRAM ring runs it the other way
+(device→executor): slabs are complete exec-bytecode programs, u64
+words stored as little-endian u32 pairs (lo then hi — a plain memory
+view of the `encodingexec` wire format), `npcs` = live u32 words, and
+the executor is the reader (native/executor.cc `prog_ring_*`).  The
+commit protocol, pow2 buckets and resync semantics carry over
+unchanged; `min_bucket` is sized to one program cap so a whole synth
+batch lands as one contiguous same-bucket run (one vectorized
+`write_batch`).  The writer-side recovery primitive for this
+direction is `skip_committed`: when an executor dies before consuming
+its slab, the fuzzer advances the read cursor past it so the next
+ringed exec reads its OWN program.
 """
 
 from __future__ import annotations
@@ -229,6 +243,65 @@ class RingWriter:
         self.stat_written += 1
         return True
 
+    def write_batch(self, win: np.ndarray, counts) -> np.ndarray:
+        """Append a whole (B, K) u32 slab matrix (row i live in
+        [:counts[i]]) — the device→executor program-batch write.  When
+        every row shares one bucket and the ring has room, the payload
+        lands as ONE contiguous block copy (the reverse-direction twin
+        of the reader's zero-copy batch view); otherwise rows fall back
+        to per-slab writes.  Returns (B,) bool written-mask (False =
+        dropped, ring full — counted, never blocking).  Tags are the
+        writer's running slab sequence (attribution/debug)."""
+        win = np.asarray(win, np.uint32)
+        counts = np.asarray(counts, np.int64)
+        r = self.ring
+        B = len(counts)
+        out = np.zeros((B,), bool)
+        if B == 0:
+            return out
+        base_tag = self.stat_written
+        clipped = np.clip(counts, 1, r.slab_cap)
+        buckets = np.maximum(
+            r.min_bucket,
+            1 << np.ceil(np.log2(clipped)).astype(np.int64))
+        bucket = int(buckets[0])
+        n = 0
+        if bool((buckets == bucket).all()) and not \
+                self.pause_before_commit:
+            resv = r.load(H_RESV)
+            head, tail, dw = r.load(H_HEAD), r.load(H_TAIL), r.data_words
+            rem = dw - head % dw
+            skip = rem if bucket > rem else 0
+            fits_idx = r.index_slots - (resv - r.load(H_CONSUMED))
+            fits_data = (dw - (head + skip - tail)) // bucket
+            contig = (dw - (head + skip) % dw) // bucket
+            n = max(min(B, int(fits_idx), int(fits_data),
+                        int(contig)), 0)
+            if n > 0:
+                off0 = (head + skip) % dw
+                slots = (resv + np.arange(n)) % r.index_slots
+                r.index[slots, 0] = 0            # commit=0 first
+                r.index[slots, 1] = (base_tag
+                                     + np.arange(n)) & 0xFFFFFFFF
+                r.index[slots, 2] = np.minimum(
+                    counts[:n], r.slab_cap).astype(np.uint32)
+                r.index[slots, 3] = (off0 + np.arange(n) * bucket
+                                     ).astype(np.uint32)
+                r.store(H_WASTED, r.load(H_WASTED) + skip)
+                r.store(H_HEAD, head + skip + n * bucket)
+                r.store(H_RESV, resv + n)        # reservation visible
+                dst = r.data[off0: off0 + n * bucket].reshape(n, bucket)
+                k = min(bucket, win.shape[1])
+                dst[:, :k] = win[:n, :k]
+                r.index[slots, 0] = 1            # commit
+                self.stat_written += n
+                out[:n] = True
+        # leftover rows (mixed buckets / ring wrap / ring full): the
+        # per-slab writer handles wrap padding and counted drops
+        for i in range(n, B):
+            out[i] = self.write(self.stat_written, win[i, : counts[i]])
+        return out
+
 
 class SlabBatch:
     """One bucket-homogeneous committed run, as zero-copy views.
@@ -363,3 +436,28 @@ class RingReader:
         if skipped:
             r.store(H_SKIPPED, r.load(H_SKIPPED) + skipped)
         return skipped
+
+
+def skip_committed(ring: PcRing, n: int = 1) -> int:
+    """Advance the read cursor past up to n COMMITTED slabs without
+    reading them — the reverse-direction (program ring) recovery: the
+    writer (fuzzer) skips a slab whose reader (executor) died before
+    consuming it, so reader/writer alignment is restored for the next
+    exec.  Only call when the reader process is known dead.  Returns
+    how many slabs were skipped (counted in `skipped_uncommitted` —
+    same header slot, same 'lost to a crash' meaning)."""
+    skipped = 0
+    while skipped < n and ring.load(H_RESV) > ring.load(H_CONSUMED):
+        cons = ring.load(H_CONSUMED)
+        rec = ring.index[cons % ring.index_slots]
+        npcs = int(rec[2])
+        bucket = bucket_words(max(npcs, 1), ring.slab_cap,
+                              ring.min_bucket)
+        tail, dw = ring.load(H_TAIL), ring.data_words
+        delta = (int(rec[3]) - tail % dw) % dw
+        ring.store(H_TAIL, tail + delta + bucket)
+        ring.store(H_CONSUMED, cons + 1)
+        skipped += 1
+    if skipped:
+        ring.store(H_SKIPPED, ring.load(H_SKIPPED) + skipped)
+    return skipped

@@ -46,6 +46,10 @@ class Config:
     admit_batch: int = 64              # NewInput coalescer batch size
     #                                    (<=1 = serial per-input admission)
     fuzzer_device: bool = False        # fuzzers run signal diffs on device
+    fuzzer_synth: bool = False         # fuzzers assemble programs on
+    #                                    device (synth_block megakernel +
+    #                                    device→executor program ring);
+    #                                    requires fuzzer_device
     telemetry: bool = True             # metrics registry + device stat
     #                                    vector + /metrics + trace spans
     telemetry_interval: float = 60.0   # snapshot persistence period (s)

@@ -1279,6 +1279,10 @@ class Manager:
             a += ["-device", "-npcs", str(self.cfg.npcs),
                   "-flush-batch", str(max(8, self.cfg.flush_batch // 8)),
                   "-corpus-cap", str(self.cfg.corpus_cap)]
+            if self.cfg.fuzzer_synth:
+                # device-resident program synthesis rides the device
+                # signal plane (synth tables + program ring per proc)
+                a.append("-synth")
         return " ".join(shlex.quote(x) for x in a)
 
     def vm_loop(self, index: int) -> None:

@@ -145,6 +145,13 @@ print("telemetry ok: %d series" % len(series))
         ringc = out["ring"]
         assert ringc["ring_resynced"] and ringc["ring_torn_skipped"] == 1, \
             ringc
+        # synth fold-in, reverse direction: executor killed mid-
+        # program-slab-READ re-reads on relaunch; fuzzer killed
+        # mid-WRITE leaves exactly one torn slab, skipped + resynced
+        prc = out["prog_ring"]
+        assert prc["prog_ring_reader_reread"] \
+            and prc["prog_ring_torn_skipped"] == 1 \
+            and prc["prog_ring_resynced"], prc
         auto = out["autopilot"]
         assert auto["recovered"] and auto["frontier_bit_exact"] \
             and auto["corpus_lost"] == 0 \
@@ -184,6 +191,14 @@ print("telemetry ok: %d series" % len(series))
         assert dev >= cpu, \
             f"zero-copy replay lost to CPU on the same backend: " \
             f"{dev} < {cpu}"
+        # device program synthesis acceptance: ≥10x the host generator
+        # on the same backend at zero warm recompiles
+        sd = out["extras"]["programs_per_sec_device"]
+        sh = out["extras"]["programs_per_sec_host"]
+        assert sd >= 10 * sh, \
+            f"synth megakernel under 10x host generator: {sd} vs {sh}"
+        assert out["extras"]["synth_recompiles_warm"] == 0, \
+            "synth megakernel recompiled warm"
 
     total = 0.0
     total += step("description tables", gen_tables)

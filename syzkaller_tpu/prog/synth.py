@@ -1,0 +1,772 @@
+"""Program-synthesis tables: pre-encoding, decoding, and the host
+reference synthesizer behind `engine.synth_block`.
+
+The device megakernel assembles complete exec-bytecode programs by
+gathering CALL-LEVEL SEGMENTS out of two fixed-capacity tables (a
+corpus of admitted programs and a bank of single-call templates) and
+editing const-arg value words in place.  That only works if every
+table row satisfies the *segment contract*:
+
+  * each call's exec encoding is position-independent — no ARG_RESULT
+    references, no COPYOUTs, no used return values — so any
+    concatenation of call segments is itself valid exec bytecode and
+    equals `serialize_for_exec` of the concatenated Prog;
+  * the row's encoding is *decodable*: `decode_words(encode(p)) == p`
+    up to byte-identical re-encoding AND byte-identical text
+    serialization, so a program slab coming back from the executor (a
+    crash! a triage item!) can be lifted to an `M.Prog` for csource
+    repro generation without any provenance side channel.
+
+`encode_program` enforces both as an admission gate: a program that
+fails either is simply not eligible for the device tables and stays on
+the host path — eligibility is a fast-path filter, never a semantics
+change.
+
+The module also carries the OPERATOR mix (derived from the host
+mutator's weights in prog/mutation.py) and `HostSynth`, a numpy
+reference implementation of the five device operators over the same
+tables.  The device kernel and `HostSynth` share `plan_entries` (the
+segment plan incl. the output-length truncation rule) and
+`materialize` (provenance → Prog replay), so the chi-square
+equivalence tests and the slab→prog→csource round trip compare two
+implementations of ONE written-down spec.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+import numpy as np
+
+from syzkaller_tpu.prog import encoding
+from syzkaller_tpu.prog import model as M
+from syzkaller_tpu.prog.encodingexec import (
+    ARG_CONST, ARG_DATA, INSTR_COPYIN, INSTR_COPYOUT, INSTR_EOF,
+    NO_RESULT, physical_addr, serialize_for_exec, _encode_scalar)
+from syzkaller_tpu.sys import types as T
+
+# ---------------------------------------------------------------------------
+# Operator catalog.  One synth output applies ONE operator; the mix is
+# the host mutator's split (prog/mutation.py): the proc loop generates
+# 1/10 of the time, and a mutation round splices 1/100 else draws
+# insert/mutate/remove at [20, 10, 1].
+
+OP_GENERATE, OP_SPLICE, OP_INSERT, OP_MUTATE, OP_SQUASH = range(5)
+OP_NAMES = ("generate", "splice", "insert", "mutate", "squash")
+
+_mut = 0.9 * 0.99 / 31.0
+OPERATOR_WEIGHTS = np.array(
+    [0.1, 0.9 * 0.01, _mut * 20.0, _mut * 10.0, _mut * 1.0], np.float64)
+
+
+@dataclass
+class EncodedProgram:
+    """One table row: a program pre-encoded to exec words (NO trailing
+    EOF) with call-segment offsets and mutable const-arg slots."""
+    prog: M.Prog
+    words: np.ndarray       # (nwords,) uint64
+    call_off: np.ndarray    # (ncalls+1,) int32; call_off[-1] == nwords
+    call_ids: np.ndarray    # (ncalls,) int32 table call ids
+    slots: list             # [(word_off, size_bytes, call_idx)]
+
+    @property
+    def nwords(self) -> int:
+        return len(self.words)
+
+    @property
+    def ncalls(self) -> int:
+        return len(self.call_ids)
+
+
+class SynthEncodeError(Exception):
+    pass
+
+
+# -- encoding with slot tracking --------------------------------------------
+#
+# Mirrors prog/encodingexec.py serialize_for_exec word for word for the
+# result-free subset, recording the stream index of every mutable
+# const-arg VALUE word.  `encode_program` verifies the mirror against
+# serialize_for_exec before admitting a row, so a drift between the two
+# encoders rejects the program instead of corrupting the tables.
+
+
+def _slot_eligible(a: M.Arg) -> bool:
+    """ConstArgs the device mutate-arg operator may edit: the plain-int
+    arm of _mutate_one (no flags/proc/range resampling on device), non
+    big-endian (the value word must equal the stored val), not padding
+    and not a length field (mirrors mutation._mutable_args)."""
+    if not isinstance(a, M.ConstArg):
+        return False
+    t = a.typ
+    if T.is_pad(t) or isinstance(t, (T.ConstType, T.LenType, T.FlagsType,
+                                     T.ProcType)):
+        return False
+    if isinstance(t, T.IntType) and t.kind == T.IntKind.RANGE:
+        return False
+    if getattr(t, "big_endian", False):
+        return False
+    if t.dir == T.Dir.OUT:
+        return False
+    return True
+
+
+def _encode_call(c: M.Call, pid: int = 0):
+    """One call's exec words + mutable slot offsets.  Raises
+    SynthEncodeError for anything outside the segment contract."""
+    w: list[int] = []
+    slots: list[tuple[int, int]] = []     # (value word index, size)
+
+    def emit_arg(a: M.Arg) -> None:
+        if isinstance(a, M.ConstArg):
+            if _slot_eligible(a):
+                slots.append((len(w) + 2, getattr(a.typ, "type_size", 8)))
+            w.extend([ARG_CONST, a.size(), _encode_scalar(a, pid)])
+        elif isinstance(a, M.ResultArg):
+            if a.res is not None:
+                raise SynthEncodeError("cross-call result reference")
+            w.extend([ARG_CONST, a.size(), _encode_scalar(a, pid)])
+        elif isinstance(a, M.PointerArg):
+            w.extend([ARG_CONST, 8,
+                      physical_addr(a) if not a.is_null else 0])
+        elif isinstance(a, M.PageSizeArg):
+            w.extend([ARG_CONST, a.size() if not isinstance(a.typ, T.LenType)
+                      else a.typ.size(), a.npages * M.PAGE_SIZE])
+        elif isinstance(a, M.DataArg):
+            n = len(a.data)
+            w.extend([ARG_DATA, n])
+            pad = a.data + b"\x00" * (-n % 8)
+            for i in range(0, len(pad), 8):
+                w.append(int.from_bytes(pad[i:i + 8], "little"))
+        else:
+            raise SynthEncodeError(f"cannot emit {type(a)} as call arg")
+
+    def emit_copyin(a: M.Arg, addr: int) -> None:
+        if isinstance(a, M.GroupArg):
+            off = 0
+            for x in a.inner:
+                emit_copyin(x, addr + off)
+                off += x.size()
+            return
+        if isinstance(a, M.UnionArg):
+            emit_copyin(a.option, addr)
+            return
+        if a.typ.dir == T.Dir.OUT and isinstance(a, M.DataArg):
+            return
+        if isinstance(a, M.DataArg) and not a.data:
+            return
+        w.append(INSTR_COPYIN)
+        w.append(addr)
+        emit_arg(a)
+        if isinstance(a, M.PointerArg) and a.res is not None:
+            emit_copyin(a.res, physical_addr(a))
+
+    def check_no_copyout(a: M.Arg) -> None:
+        def visit(x, _p):
+            if isinstance(x, M.ResultArg) and x.uses:
+                raise SynthEncodeError("out-resource with uses")
+        M.foreach_subarg(a, visit)
+
+    if c.ret is not None and c.ret.uses:
+        raise SynthEncodeError("used return value")
+    for a in c.args:
+        check_no_copyout(a)
+        if isinstance(a, M.PointerArg) and a.res is not None:
+            emit_copyin(a.res, physical_addr(a))
+    w.append(c.meta.nr)
+    w.append(NO_RESULT)
+    w.append(len(c.args))
+    for a in c.args:
+        emit_arg(a)
+    return np.array(w, np.uint64), slots
+
+
+def encode_program(p: M.Prog, table=None, pid: int = 0,
+                   verify: bool = True) -> "EncodedProgram | None":
+    """Pre-encode a program into a table row, or None if it violates
+    the segment contract.  With `table` given (and verify=True) the
+    decode gate also runs: the row must lift back to a Prog whose
+    exec AND text serializations are byte-identical — csource repro
+    round trips by construction for everything in the tables."""
+    words_parts: list[np.ndarray] = []
+    call_off = [0]
+    slots: list[tuple[int, int, int]] = []
+    try:
+        for ci, c in enumerate(p.calls):
+            cw, cslots = _encode_call(c, pid)
+            slots.extend((call_off[-1] + off, size, ci)
+                         for off, size in cslots)
+            words_parts.append(cw)
+            call_off.append(call_off[-1] + len(cw))
+    except SynthEncodeError:
+        return None
+    words = (np.concatenate(words_parts) if words_parts
+             else np.zeros(0, np.uint64))
+    if verify:
+        # mirror check: segments + EOF must equal the production encoder
+        ref = np.frombuffer(serialize_for_exec(p, pid), np.uint64)
+        full = np.concatenate([words, [np.uint64(INSTR_EOF)]])
+        if not np.array_equal(full, ref):
+            return None
+    enc = EncodedProgram(
+        prog=p, words=words, call_off=np.array(call_off, np.int32),
+        call_ids=np.array([c.meta.id for c in p.calls], np.int32),
+        slots=slots)
+    if verify and table is not None:
+        try:
+            q = decode_words(np.concatenate(
+                [words, [np.uint64(INSTR_EOF)]]), table)
+        except SynthDecodeError:
+            return None
+        if serialize_for_exec(q, pid) != serialize_for_exec(p, pid):
+            return None
+        # the round-trip criterion: a slab built from this row must
+        # lift back to a byte-identical C repro.  Wire-ambiguous
+        # variants (same encoding, same kernel call) pass — csource
+        # output is identical by construction.
+        from syzkaller_tpu import csource
+        try:
+            if csource.generate(q) != csource.generate(p):
+                return None
+        except Exception:
+            return None
+    return enc
+
+
+# ---------------------------------------------------------------------------
+# Slab → Prog decoding.  Candidate metas are tried by syscall nr; a
+# candidate wins iff the rebuilt call RE-ENCODES to the identical word
+# segment — decode is verified-by-construction, never heuristic.
+
+
+class SynthDecodeError(Exception):
+    pass
+
+
+def _inv_scalar(t: T.Type, enc: int) -> int:
+    """Invert _encode_scalar for pid=0 (byte-order + proc bias)."""
+    size = getattr(t, "type_size", 8)
+    v = enc & ((1 << (8 * size)) - 1)
+    if getattr(t, "big_endian", False):
+        v = int.from_bytes(v.to_bytes(size, "big"), "little")
+    if isinstance(t, T.ProcType):
+        v -= t.values_start
+        if v < 0:
+            raise SynthDecodeError("proc value below values_start")
+    return v
+
+
+class _SegDecoder:
+    """Decode ONE call segment: its copyins + the CALL record."""
+
+    def __init__(self, copyins: dict, nr: int, raw_args: list):
+        # copyins: DATA-WINDOW-RELATIVE addr -> (kind, size, payload)
+        self.copyins = copyins
+        self.nr = nr
+        self.raw_args = raw_args   # [(kind, size, value_or_bytes)]
+
+    def build(self, meta: T.Syscall) -> M.Call:
+        if meta.nr != self.nr or len(meta.args) != len(self.raw_args):
+            raise SynthDecodeError("signature mismatch")
+        args = [self._top_arg(t, raw)
+                for t, raw in zip(meta.args, self.raw_args)]
+        c = M.Call(meta, args)
+        if meta.ret is not None:
+            c.ret = M.ReturnArg(meta.ret)
+        self._fix_len_args(c, meta)
+        return c
+
+    def _top_arg(self, t: T.Type, raw) -> M.Arg:
+        kind, size, val = raw
+        if isinstance(t, T.BufferType):
+            if kind != ARG_DATA:
+                raise SynthDecodeError("expected data arg")
+            return M.DataArg(t, val)
+        if kind != ARG_CONST:
+            raise SynthDecodeError("unsupported arg kind")
+        if isinstance(t, (T.PtrType, T.VmaType)):
+            return self._pointer(t, val)
+        if isinstance(t, T.ResourceType):
+            return M.ResultArg(t, None, _inv_scalar(t, val))
+        return M.ConstArg(t, _inv_scalar(t, val))
+
+    def _pointer(self, t: T.Type, enc_addr: int) -> M.PointerArg:
+        if enc_addr == 0:
+            if isinstance(t, T.VmaType):
+                return M.PointerArg(t, 0, 0, 1, None)
+            return M.PointerArg(t, 0, 0, 0, None)
+        addr = enc_addr - M.DATA_OFFSET
+        if addr < 0:
+            raise SynthDecodeError("address below data window")
+        page, off = divmod(addr, M.PAGE_SIZE)
+        if isinstance(t, T.VmaType):
+            return M.PointerArg(t, page, off, 1, None)
+        elem = t.elem
+        if elem is None:
+            elem = T.BufferType(name="blob", dir=t.dir,
+                                kind=T.BufferKind.BLOB_RAND)
+        res = self._pointee(elem, addr)
+        return M.PointerArg(t, page, off, 0, res)
+
+    def _pointee(self, t: T.Type, addr: int) -> M.Arg:
+        if isinstance(t, T.StructType):
+            inner = []
+            cur = addr
+            for ft in t.fields:
+                a = self._pointee(ft, cur)
+                inner.append(a)
+                cur += a.size()
+            return M.GroupArg(t, inner)
+        if isinstance(t, T.UnionType):
+            errs = None
+            for opt in t.options:
+                try:
+                    return M.UnionArg(t, self._pointee(opt, addr), opt)
+                except SynthDecodeError as e:
+                    errs = e
+            raise SynthDecodeError(f"no union option decodes: {errs}")
+        if isinstance(t, T.ArrayType):
+            inner = []
+            cur = addr
+            lo, hi = 0, 64
+            if t.kind == T.ArrayKind.RANGE_LEN:
+                lo, hi = t.range_begin, min(t.range_end, 64)
+            while len(inner) < hi:
+                try:
+                    a = self._pointee(t.elem, cur)
+                except SynthDecodeError:
+                    if len(inner) < lo:
+                        raise
+                    break
+                if a.size() == 0 and len(inner) >= lo:
+                    break          # empty leaf: no progress possible
+                inner.append(a)
+                cur += a.size()
+            return M.GroupArg(t, inner)
+        if isinstance(t, T.PtrType):
+            kind, size, val = self._leaf(addr)
+            if kind != ARG_CONST:
+                raise SynthDecodeError("pointer field not const")
+            return self._pointer(t, val)
+        if isinstance(t, T.VmaType):
+            kind, size, val = self._leaf(addr)
+            return self._pointer(t, val)
+        if isinstance(t, T.BufferType):
+            if t.dir == T.Dir.OUT:
+                # OUT data is never copied in; only fixed-size buffers
+                # reconstruct (varlen OUT lengths are unrecoverable —
+                # the encode gate rejects those rows)
+                fs = t.fixed_size()
+                if fs is None:
+                    raise SynthDecodeError("varlen OUT buffer")
+                return M.DataArg(t, bytes(fs))
+            if addr not in self.copyins:
+                return M.DataArg(t, b"")    # empty data: copyin skipped
+            kind, size, val = self.copyins[addr]
+            if kind != ARG_DATA:
+                raise SynthDecodeError("buffer field not data")
+            return M.DataArg(t, val)
+        # scalar leaf; the wire carries the emitted size — a mismatch
+        # (e.g. the wrong union option) rejects this reconstruction
+        kind, size, val = self._leaf(addr)
+        if kind != ARG_CONST:
+            raise SynthDecodeError("scalar field not const")
+        if size != t.size():
+            raise SynthDecodeError(
+                f"scalar size {size} != {t.size()} for {t.name}")
+        if isinstance(t, T.ResourceType):
+            return M.ResultArg(t, None, _inv_scalar(t, val))
+        return M.ConstArg(t, _inv_scalar(t, val))
+
+    def _leaf(self, addr: int):
+        if addr not in self.copyins:
+            raise SynthDecodeError(f"no copyin at {addr:#x}")
+        return self.copyins[addr]
+
+    def _fix_len_args(self, c: M.Call, meta: T.Syscall) -> None:
+        """LenType args whose referent is a vma sibling become
+        PageSizeArgs (the generator builds vma lengths that way; the
+        wire carries only the byte length, npages = len/PAGE_SIZE).
+        Field names are positional on the wire, so the pairing is the
+        sibling-VmaType heuristic — a wrong guess re-encodes
+        differently and rejects the candidate, never corrupts."""
+        vma_idx = [j for j, t in enumerate(meta.args)
+                   if isinstance(t, T.VmaType)]
+        if not vma_idx:
+            return
+        for i, t in enumerate(meta.args):
+            if not isinstance(t, T.LenType) or t.byte_size:
+                continue
+            a = c.args[i]
+            if isinstance(a, M.ConstArg) and a.val % M.PAGE_SIZE == 0:
+                npages = a.val // M.PAGE_SIZE
+                c.args[i] = M.PageSizeArg(t, npages)
+                tgt = c.args[vma_idx[0]]
+                if npages >= 1 and isinstance(tgt, M.PointerArg) \
+                        and not tgt.is_null:
+                    tgt.npages = npages
+
+
+def _parse_stream(words: np.ndarray):
+    """Split an exec word stream into per-call segments.  Each segment
+    is (copyins, copyin_order, nr, raw_args): `copyins` keys DATA-
+    WINDOW-RELATIVE addresses for pointee lookup, `copyin_order` keeps
+    the emitted (physical addr, raw) sequence for verification.
+    Copyins attach to the NEXT call (the emit order)."""
+    segs = []
+    copyins: dict[int, tuple] = {}
+    order: list[tuple[int, tuple]] = []
+    i = 0
+    n = len(words)
+
+    def read_arg(i):
+        kind = int(words[i])
+        if kind == ARG_CONST:
+            return (ARG_CONST, int(words[i + 1]), int(words[i + 2])), i + 3
+        if kind == ARG_DATA:
+            nbytes = int(words[i + 1])
+            nw = (nbytes + 7) // 8
+            data = words[i + 2: i + 2 + nw].tobytes()[:nbytes]
+            return (ARG_DATA, nbytes, data), i + 2 + nw
+        raise SynthDecodeError(f"unsupported arg kind {kind}")
+
+    while i < n:
+        w = int(words[i])
+        if w == INSTR_EOF:
+            break
+        if w == INSTR_COPYIN:
+            phys = int(words[i + 1])
+            raw, i = read_arg(i + 2)
+            copyins[phys - M.DATA_OFFSET] = raw
+            order.append((phys, raw))
+            continue
+        if w == INSTR_COPYOUT:
+            raise SynthDecodeError("copyout outside segment contract")
+        nr = w
+        ridx = int(words[i + 1])
+        if ridx != NO_RESULT:
+            raise SynthDecodeError("used result outside segment contract")
+        nargs = int(words[i + 2])
+        i += 3
+        raw_args = []
+        for _ in range(nargs):
+            raw, i = read_arg(i)
+            raw_args.append(raw)
+        segs.append((copyins, order, nr, raw_args))
+        copyins = {}
+        order = []
+    return segs
+
+
+def decode_words(words: np.ndarray, table) -> M.Prog:
+    """Lift an exec word stream (uint64, EOF-terminated or not) back to
+    an M.Prog.  Each call tries every meta sharing the syscall nr and
+    keeps the first whose reconstruction RE-ENCODES byte-identically —
+    so a successful decode is self-verifying."""
+    words = np.asarray(words, np.uint64)
+    by_nr: dict[int, list] = {}
+    for meta in table.calls:
+        by_nr.setdefault(meta.nr, []).append(meta)
+    p = M.Prog()
+    for copyins, order, nr, raw_args in _parse_stream(words):
+        cands = by_nr.get(nr)
+        if not cands:
+            raise SynthDecodeError(f"unknown syscall nr {nr}")
+        dec = _SegDecoder(copyins, nr, raw_args)
+        want = _segment_words(order, nr, raw_args)
+        call = None
+        for meta in cands:
+            try:
+                c = dec.build(meta)
+                got, _slots = _encode_call(c)
+            except (SynthDecodeError, SynthEncodeError):
+                continue
+            if np.array_equal(got, want):
+                call = c
+                break
+        if call is None:
+            raise SynthDecodeError(
+                f"no meta for nr {nr} re-encodes identically")
+        p.calls.append(call)
+    return p
+
+
+def _segment_words(order, nr, raw_args) -> np.ndarray:
+    """Re-emit one parsed segment's words (the decode-verification
+    reference): copyins in their original emitted order + the CALL."""
+    w: list[int] = []
+    for phys, raw in order:
+        w.extend([INSTR_COPYIN, phys])
+        _emit_raw(w, *raw)
+    w.extend([nr, NO_RESULT, len(raw_args)])
+    for raw in raw_args:
+        _emit_raw(w, *raw)
+    return np.array(w, np.uint64)
+
+
+def _emit_raw(w: list, kind: int, size: int, val) -> None:
+    if kind == ARG_CONST:
+        w.extend([ARG_CONST, size, val])
+    else:
+        w.extend([ARG_DATA, size])
+        pad = val + b"\x00" * (-size % 8)
+        for i in range(0, len(pad), 8):
+            w.append(int.from_bytes(pad[i:i + 8], "little"))
+
+
+# ---------------------------------------------------------------------------
+# The shared operator spec: segment planning + provenance replay.
+
+
+@dataclass
+class Provenance:
+    """Everything needed to replay one synth output host-side."""
+    op: int
+    r1: int = 0
+    r2: int = 0
+    cut: int = 0            # splice insertion call index
+    pos: int = 0            # insert-call position
+    dele: int = -1          # squash: removed call (-1 = degenerate no-op)
+    k: int = 0              # generate: drawn call count
+    gen_tmpls: tuple = ()   # generate: template indices (k live)
+    ins_tmpl: int = -1      # insert: template index
+    slot: int = -1          # mutate: slot ordinal (-1 = no slots, no-op)
+    mut_kind: int = 0
+    mut_val: int = 0        # final masked 64-bit value
+    n_entries: int = 0      # kept entries after the length cap
+
+
+def plan_entries(prov: Provenance, rows: list, tmpls: list,
+                 max_words: int, max_entries: int) -> list:
+    """The single written-down segment plan both implementations
+    follow: the operator's (table, index, call) entry list, truncated
+    to `max_entries` entries and then to the longest prefix whose word
+    total fits max_words-1 (one word reserved for EOF).  rows/tmpls are
+    EncodedProgram lists."""
+    op = prov.op
+    ent: list[tuple[int, int, int]] = []   # (tbl, idx, call)
+    if op == OP_GENERATE:
+        ent = [(1, t, 0) for t in prov.gen_tmpls[: prov.k]]
+    elif op == OP_SPLICE:
+        n1 = rows[prov.r1].ncalls
+        n2 = rows[prov.r2].ncalls
+        ent = ([(0, prov.r1, j) for j in range(prov.cut)]
+               + [(0, prov.r2, j) for j in range(n2)]
+               + [(0, prov.r1, j) for j in range(prov.cut, n1)])
+    elif op == OP_INSERT:
+        n1 = rows[prov.r1].ncalls
+        ent = ([(0, prov.r1, j) for j in range(prov.pos)]
+               + [(1, prov.ins_tmpl, 0)]
+               + [(0, prov.r1, j) for j in range(prov.pos, n1)])
+    elif op == OP_MUTATE:
+        ent = [(0, prov.r1, j) for j in range(rows[prov.r1].ncalls)]
+    elif op == OP_SQUASH:
+        n1 = rows[prov.r1].ncalls
+        ent = [(0, prov.r1, j) for j in range(n1) if j != prov.dele]
+    ent = ent[:max_entries]
+    out = []
+    total = 0
+    for tbl, idx, call in ent:
+        enc = tmpls[idx] if tbl else rows[idx]
+        seglen = (enc.nwords if tbl
+                  else int(enc.call_off[call + 1] - enc.call_off[call]))
+        if total + seglen > max_words - 1:
+            break
+        total += seglen
+        out.append((tbl, idx, call))
+    return out
+
+
+def emit_words(prov: Provenance, rows: list, tmpls: list,
+               max_words: int, max_entries: int) -> np.ndarray:
+    """Host-reference word emission: gather the planned segments,
+    apply the mutate edit, append EOF — the numpy twin of the device
+    assembly gather."""
+    ent = plan_entries(prov, rows, tmpls, max_words, max_entries)
+    parts = []
+    for tbl, idx, call in ent:
+        enc = tmpls[idx] if tbl else rows[idx]
+        if tbl:
+            parts.append(enc.words)
+        else:
+            parts.append(enc.words[enc.call_off[call]:
+                                   enc.call_off[call + 1]])
+    words = (np.concatenate(parts) if parts
+             else np.zeros(0, np.uint64))
+    if prov.op == OP_MUTATE and prov.slot >= 0:
+        woff, _size, _ci = rows[prov.r1].slots[prov.slot]
+        words = words.copy()
+        words[woff] = np.uint64(prov.mut_val)
+    return np.concatenate([words, [np.uint64(INSTR_EOF)]])
+
+
+def materialize(prov: Provenance, rows: list, tmpls: list,
+                max_words: int, max_entries: int) -> M.Prog:
+    """Provenance → M.Prog replay: clone the planned source calls and
+    apply the mutate edit on the cloned const arg.  serialize_for_exec
+    of the result equals the emitted slab bit for bit (the round-trip
+    tests pin this per operator)."""
+    ent = plan_entries(prov, rows, tmpls, max_words, max_entries)
+    p = M.Prog()
+    for tbl, idx, call in ent:
+        enc = tmpls[idx] if tbl else rows[idx]
+        if tbl:
+            p.calls.extend(M.clone_prog(enc.prog).calls)
+        else:
+            p.calls.extend(M.clone_prog(
+                M.Prog(calls=[enc.prog.calls[call]])).calls)
+    if prov.op == OP_MUTATE and prov.slot >= 0:
+        _woff, size, _ci = rows[prov.r1].slots[prov.slot]
+        _set_slot(p, prov.slot, prov.mut_val, size)
+    return p
+
+
+def _set_slot(p: M.Prog, slot: int, val: int, size: int) -> None:
+    """Apply a mutate edit to the cloned prog: re-enumerate the clone's
+    eligible const args in encode order (deterministic — same walk as
+    _encode_call) and set the slot'th one."""
+    found = [0]
+
+    def walk_call(c: M.Call):
+        order: list[M.ConstArg] = []
+
+        def visit_copyin(a: M.Arg):
+            if isinstance(a, M.GroupArg):
+                for x in a.inner:
+                    visit_copyin(x)
+                return
+            if isinstance(a, M.UnionArg):
+                visit_copyin(a.option)
+                return
+            if a.typ.dir == T.Dir.OUT and isinstance(a, M.DataArg):
+                return
+            if isinstance(a, M.DataArg) and not a.data:
+                return
+            if _slot_eligible(a):
+                order.append(a)           # the emit_arg inside copyin
+            if isinstance(a, M.PointerArg) and a.res is not None:
+                visit_copyin(a.res)
+
+        for a in c.args:
+            if isinstance(a, M.PointerArg) and a.res is not None:
+                visit_copyin(a.res)
+        for a in c.args:
+            if _slot_eligible(a):
+                order.append(a)
+        return order
+
+    want = slot
+    for c in p.calls:
+        order = walk_call(c)
+        if want < len(order):
+            a = order[want]
+            a.val = val & ((1 << (8 * size)) - 1)
+            return
+        want -= len(order)
+    # slot beyond the truncated output: the edit fell off with its
+    # call — a legal no-op (the kernel's edit lands inside the row's
+    # identity prefix, which mutate never truncates, so this only
+    # happens for degenerate hand-built provenance)
+
+
+# ---------------------------------------------------------------------------
+# Host reference synthesizer (the distribution spec the device kernel
+# must match; numpy RNG).
+
+
+class HostSynth:
+    """Numpy reference for the five operators over shared tables.
+
+    Index draws are floor(u * n) over real uniforms and the insert
+    position is floor(u^(1/5) * n) (biased_rand k=5) — the exact
+    formulas the device kernel computes, so per-operator chi-square
+    tests compare two implementations of one spec."""
+
+    def __init__(self, rows: list, tmpls: list, call2tmpl: np.ndarray,
+                 probs: np.ndarray, enabled: np.ndarray,
+                 max_words: int = 192, max_entries: int = 12,
+                 gen_max: int = 6, rng=None):
+        self.rows = rows
+        self.tmpls = tmpls
+        self.call2tmpl = np.asarray(call2tmpl, np.int64)
+        self.probs = np.asarray(probs, np.float64)
+        self.enabled = np.asarray(enabled, bool)
+        self.max_words = max_words
+        self.max_entries = max_entries
+        self.gen_max = gen_max
+        self.rng = rng or np.random.default_rng(0)
+
+    def _draw_call(self, prev: int) -> int:
+        C = self.probs.shape[0]
+        row = self.probs[prev] if prev >= 0 else np.ones(C)
+        w = np.where(self.enabled & (self.call2tmpl >= 0), row, 0.0)
+        tot = w.sum()
+        if tot <= 0:
+            return int(np.argmax(self.call2tmpl >= 0))
+        cdf = np.cumsum(w)
+        u = self.rng.random() * tot
+        return int(np.searchsorted(cdf, u, side="right").clip(0, C - 1))
+
+    def _intn(self, n: int) -> int:
+        return int(self.rng.random() * n) if n > 0 else 0
+
+    def synth_one(self) -> Provenance:
+        nrows = len(self.rows)
+        if nrows == 0:
+            op = OP_GENERATE
+        else:
+            w = OPERATOR_WEIGHTS
+            u = self.rng.random() * w.sum()
+            op = int(np.searchsorted(np.cumsum(w), u, side="right")
+                     .clip(0, len(w) - 1))
+        prov = Provenance(op=op)
+        if op == OP_GENERATE:
+            prov.k = 1 + self._intn(self.gen_max)
+            prev = -1
+            tg = []
+            for _ in range(prov.k):
+                cid = self._draw_call(prev)
+                tg.append(int(max(self.call2tmpl[cid], 0)))
+                prev = cid
+            prov.gen_tmpls = tuple(tg)
+        else:
+            prov.r1 = self._intn(nrows)
+            n1 = self.rows[prov.r1].ncalls
+            if op == OP_SPLICE:
+                prov.r2 = self._intn(nrows)
+                prov.cut = self._intn(n1 + 1)
+            elif op == OP_INSERT:
+                u = self.rng.random()
+                prov.pos = min(int((n1 + 1) * u ** 0.2), n1)
+                prev = (int(self.rows[prov.r1].call_ids[prov.pos - 1])
+                        if prov.pos > 0 else -1)
+                prov.ins_tmpl = int(max(
+                    self.call2tmpl[self._draw_call(prev)], 0))
+            elif op == OP_MUTATE:
+                nslots = len(self.rows[prov.r1].slots)
+                if nslots > 0:
+                    prov.slot = self._intn(nslots)
+                    woff, size, _ci = self.rows[prov.r1].slots[prov.slot]
+                    old = int(self.rows[prov.r1].words[woff])
+                    prov.mut_kind = self._intn(3)
+                    mask = (1 << (8 * size)) - 1
+                    if prov.mut_kind == 0:
+                        v = int(self.rng.integers(0, 1 << 32)) | (
+                            int(self.rng.integers(0, 1 << 32)) << 32)
+                    elif prov.mut_kind == 1:
+                        delta = 1 + self._intn(16)
+                        sign = 1 if self.rng.random() < 0.5 else -1
+                        v = (old + sign * delta) % (1 << 64)
+                    else:
+                        v = old ^ (1 << self._intn(64))
+                    prov.mut_val = v & mask
+            elif op == OP_SQUASH:
+                prov.dele = self._intn(n1) if n1 > 1 else -1
+        prov.n_entries = len(plan_entries(
+            prov, self.rows, self.tmpls, self.max_words,
+            self.max_entries))
+        return prov
+
+    def emit(self, prov: Provenance) -> np.ndarray:
+        return emit_words(prov, self.rows, self.tmpls, self.max_words,
+                          self.max_entries)

@@ -544,6 +544,144 @@ def run_ring_chaos(base_dir: str, n_slabs: int = 24,
     return out
 
 
+_PROG_RING_READER_SCRIPT = r"""
+import os, sys, time
+import numpy as np
+sys.path.insert(0, sys.argv[2])
+from syzkaller_tpu.ipc import ring as R
+ring = R.PcRing.attach(sys.argv[1])
+reader = R.RingReader(ring)
+pause_first = len(sys.argv) > 3 and sys.argv[3] == "pause"
+read = 0
+while True:
+    b = reader.read_batch(max_slabs=1)
+    if b is None:
+        time.sleep(0.005)
+        continue
+    sys.stdout.write("READ %d %d\n" % (int(b.tags[0]), int(b.counts[0])))
+    sys.stdout.flush()
+    if pause_first and read == 0:
+        # the executor analog: slab read (decode started) but NOT yet
+        # consumed — the parent SIGKILLs us here, mid-program-slab-read
+        while True:
+            time.sleep(0.05)
+    reader.consume(b)
+    read += 1
+    sys.stdout.write("CONSUMED %d\n" % read)
+    sys.stdout.flush()
+"""
+
+
+def run_prog_ring_chaos(base_dir: str, n_slabs: int = 12,
+                        verbose: bool = False) -> dict:
+    """Reverse-direction (device→executor program ring) chaos, both
+    failure sides of the synth plane:
+
+    1. SIGKILL the READER mid-program-slab-read (after read_batch,
+       before consume — the executor dying mid-decode/mid-exec): a new
+       reader generation attaches, RE-READS the unconsumed slab (its
+       consumed_idx never advanced — at-least-once), and drains the
+       rest intact; the writer side proves `skip_committed` restores
+       alignment when the replacement should NOT re-execute.
+    2. SIGKILL the WRITER mid-slab-write (reservation published,
+       payload/commit never lands — the fuzzer dying mid-batch): the
+       reader skips exactly the torn slab BY ITS LENGTH PREFIX,
+       counted not crashed, and a fresh writer generation flows."""
+    from syzkaller_tpu.ipc import ring as ring_mod
+
+    os.makedirs(base_dir, exist_ok=True)
+    path = os.path.join(base_dir, "chaos-prog-ring")
+    ring = ring_mod.PcRing.create(path, data_words=1 << 14,
+                                  index_slots=256, slab_cap=1024,
+                                  min_bucket=128)
+    writer = ring_mod.RingWriter(ring)
+    out: dict = {}
+    t0 = time.monotonic()
+
+    # --- side 1: reader (executor) dies mid-read ----------------------
+    slabs = [np.arange(200 + i, 200 + i + 40, dtype=np.uint32)
+             for i in range(n_slabs)]
+    for i, s in enumerate(slabs):
+        assert writer.write(i, s)
+
+    def spawn_reader(pause):
+        args = [sys.executable, "-c", _PROG_RING_READER_SCRIPT, path,
+                repo_root()] + (["pause"] if pause else [])
+        return subprocess.Popen(args, stdout=subprocess.PIPE, text=True)
+
+    r1 = spawn_reader(pause=True)
+    line = r1.stdout.readline().split()
+    assert line and line[0] == "READ", line
+    first_tag = int(line[1])
+    sigkill(r1)
+    r1.wait()
+    # consumed never advanced: the slab is still owned by the (dead)
+    # reader's successor
+    assert ring.load(ring_mod.H_CONSUMED) == 0
+    r2 = spawn_reader(pause=False)
+    reread = r2.stdout.readline().split()
+    assert reread[0] == "READ" and int(reread[1]) == first_tag, \
+        f"replacement reader did not re-read slab {first_tag}: {reread}"
+    consumed = 0
+    deadline = time.monotonic() + 30
+    while consumed < n_slabs and time.monotonic() < deadline:
+        ln = r2.stdout.readline().split()
+        if ln and ln[0] == "CONSUMED":
+            consumed = int(ln[1])
+    sigkill(r2)
+    r2.wait()
+    assert consumed == n_slabs, f"only {consumed}/{n_slabs} consumed"
+    out["prog_ring_reader_reread"] = True
+
+    # writer-side alignment restore: the skip_committed primitive the
+    # fuzzer uses when the dead executor's slab must NOT re-execute
+    assert writer.write(100, np.arange(64, dtype=np.uint32))
+    assert ring_mod.skip_committed(ring, 1) == 1
+    assert ring.load(ring_mod.H_CONSUMED) == ring.load(ring_mod.H_RESV)
+    out["prog_ring_skip_committed"] = 1
+
+    # --- side 2: writer (fuzzer) dies mid-slab-write ------------------
+    w1 = subprocess.Popen(
+        [sys.executable, "-c", _RING_WRITER_SCRIPT, path, repo_root(),
+         "4", "tear"], stdout=subprocess.PIPE, text=True)
+    assert w1.stdout.readline().strip() == "TEARING"
+    deadline = time.monotonic() + 30
+    base_resv = ring.load(ring_mod.H_CONSUMED)
+    while ring.load(ring_mod.H_RESV) < base_resv + 5:
+        if time.monotonic() > deadline:
+            raise AssertionError("torn reservation never appeared")
+        time.sleep(0.01)
+    sigkill(w1)
+    w1.wait()
+    reader = ring_mod.RingReader(ring)
+    got = 0
+    while True:
+        b = reader.read_batch()
+        if b is None:
+            break
+        got += b.n
+        reader.consume(b)
+    assert got == 4, f"committed pre-tear slabs lost: {got}"
+    skipped_before = ring.load(ring_mod.H_SKIPPED)
+    skipped = reader.resync()
+    assert skipped == 1, f"torn slab not skipped ({skipped})"
+    # a fresh writer generation (fuzzer restart) flows again
+    w2 = ring_mod.RingWriter(ring)
+    assert w2.write(999, np.arange(32, dtype=np.uint32))
+    b = reader.read_batch()
+    assert b is not None and b.n == 1 and int(b.tags[0]) == 999
+    reader.consume(b)
+    out["prog_ring_torn_skipped"] = skipped
+    out["prog_ring_resynced"] = True
+    out["prog_ring_chaos_seconds"] = round(time.monotonic() - t0, 3)
+    if verbose:
+        print(f"[chaos] prog ring: reader re-read slab {first_tag} "
+              f"after mid-read kill, {got} committed + 1 post-tear "
+              f"slabs intact, {skipped} torn slab skipped", flush=True)
+    ring.close()
+    return out
+
+
 def _admit_direct(mgr, inp, name: str = "serial") -> dict:
     data, call, ci, cover = inp
     from syzkaller_tpu import rpc as rpc_mod

@@ -73,6 +73,16 @@ SCALAR_SLOTS = [
     ("ingest_ring_full", "syz_ingest_ring_full_total", {}),
     ("ingest_resync", "syz_ingest_resync_skipped_total", {}),
     ("ingest_new_keys", "syz_ingest_new_keys_total", {}),
+    # device program synthesis: dispatch/program counts are bumped
+    # INSIDE the synth megakernel; ring slab writes (and drops), synth
+    # underruns and table growth are host-known events staged through
+    # the pending buffer
+    ("synth_batches", "syz_synth_dispatches_total", {}),
+    ("synth_programs", "syz_synth_programs_total", {}),
+    ("synth_slabs", "syz_synth_slabs_total", {}),
+    ("synth_ring_full", "syz_synth_ring_full_total", {}),
+    ("synth_underrun", "syz_synth_underrun_total", {}),
+    ("synth_table_rows", "syz_synth_table_rows_total", {}),
 ]
 
 HIST_SLOTS = [
@@ -88,6 +98,9 @@ HIST_SLOTS = [
     # dispatch→resolved latency of one slab-batch translate+update
     # through the ingest plane, host-observed
     ("ingest_translate_latency", "syz_ingest_batch_translate_seconds"),
+    # dispatch→consumable latency of one synth block (program batch),
+    # host-observed like the choice-block histogram
+    ("synth_block_consume_latency", "syz_synth_block_consume_seconds"),
 ]
 
 

@@ -39,7 +39,12 @@ ROOTS: dict[str, set[str]] = {
         "submit_slabs", "_resolve_slab", "_fixup_misses", "submit_batch",
         "resolve", "_slabify", "_map_rows",
     },
-    "ipc/ring.py": {"read_batch", "consume", "write"},
+    # device program synthesis: the per-exec consumer path (queue pop +
+    # ring write + outcome bookkeeping).  Table growth/build and the
+    # per-BATCH resolve are admission-rate paths, not per-exec.
+    "fuzzer/synth.py": {"next_program", "_refill", "_publish",
+                        "_write_ring", "call_ids", "exec_bytes"},
+    "ipc/ring.py": {"read_batch", "consume", "write", "write_batch"},
     "ipc/env.py": {"exec", "_parse_output"},
 }
 

@@ -1,0 +1,510 @@
+"""Device program synthesis tests: the synth_block megakernel's
+per-operator distribution equivalence vs the host reference (chi-
+square, mirroring tests/test_decision_stream.py), slab→prog→C-repro
+round trips per operator, compile-count pins across 1k mixed-size
+batches with growing tables, the device→executor program ring (both
+write paths + resync), and the slab-attach executor exec path."""
+
+import os
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from syzkaller_tpu import csource
+from syzkaller_tpu import prog as P
+from syzkaller_tpu.cover.engine import CoverageEngine
+from syzkaller_tpu.fuzzer.synth import DeviceSynth, SynthStream
+from syzkaller_tpu.prog import model as M
+from syzkaller_tpu.prog import synth as PS
+from syzkaller_tpu.prog.encodingexec import serialize_for_exec
+from syzkaller_tpu.sys.table import load_table
+
+from tests.test_decision_stream import (chi2_crit, chi2_stat,
+                                        chi2_two_sample)
+
+
+@pytest.fixture(scope="module")
+def table():
+    return load_table(files=["probe.txt"])
+
+
+def make_synth(table, batch=64, seed=5, rows=8, row_ncalls=None,
+               rand_seed=9):
+    eng = CoverageEngine(npcs=1 << 12, ncalls=table.count,
+                         corpus_cap=64, seed=seed)
+    eng.set_enabled(range(table.count))
+    ds = DeviceSynth(eng, table, batch=batch)
+    rand = P.Rand(np.random.default_rng(rand_seed))
+    ds.build_templates(range(table.count), rand)
+    assert ds.n_templates >= 10
+    added = 0
+    while added < rows:
+        p = P.generate(rand, table, row_ncalls or 5)
+        if row_ncalls is not None:
+            enc = PS.encode_program(p, table)
+            if enc is None or enc.ncalls != row_ncalls:
+                continue
+            added += bool(ds.add_program(p))
+        else:
+            added += bool(ds.add_program(p))
+    return eng, ds, rand
+
+
+def slab_words64(sp) -> np.ndarray:
+    return sp.words32[: sp.len32].view(np.uint64)
+
+
+# -- encoding / segment contract -------------------------------------------
+
+
+def test_encode_program_segment_contract(table):
+    """Eligible rows mirror serialize_for_exec word for word; programs
+    with cross-call result references are rejected, not corrupted."""
+    rand = P.Rand(np.random.default_rng(3))
+    seen_ok = seen_bad = 0
+    for _ in range(60):
+        p = P.generate(rand, table, 5)
+        enc = PS.encode_program(p, table)
+        if enc is None:
+            seen_bad += 1
+            continue
+        seen_ok += 1
+        full = np.concatenate([enc.words,
+                               [np.uint64((1 << 64) - 1)]])
+        assert np.array_equal(
+            full, np.frombuffer(serialize_for_exec(p), np.uint64))
+        # call segments tile the row exactly
+        assert enc.call_off[0] == 0
+        assert enc.call_off[-1] == enc.nwords
+        assert enc.ncalls == len(p.calls)
+        # slots point at const VALUE words inside the row
+        for woff, size, ci in enc.slots:
+            assert 0 <= woff < enc.nwords
+            assert size in (1, 2, 4, 8)
+            assert 0 <= ci < enc.ncalls
+    assert seen_ok >= 10 and seen_bad >= 1
+
+
+def test_decode_roundtrip_random_programs(table):
+    """decode_words lifts every admitted row back to a Prog whose exec
+    AND csource serializations are byte-identical."""
+    rand = P.Rand(np.random.default_rng(21))
+    checked = 0
+    while checked < 15:
+        p = P.generate(rand, table, 4)
+        enc = PS.encode_program(p, table)
+        if enc is None:
+            continue
+        checked += 1
+        q = PS.decode_words(
+            np.frombuffer(serialize_for_exec(p), np.uint64), table)
+        assert serialize_for_exec(q) == serialize_for_exec(p)
+        assert csource.generate(q) == csource.generate(p)
+
+
+# -- the megakernel: slab exactness per operator ----------------------------
+
+
+def collect_ops(ds, want_each: int = 1, max_batches: int = 40):
+    """Dispatch until every operator appeared at least want_each
+    times; returns all programs."""
+    out = []
+    counts = np.zeros(5, np.int64)
+    for _ in range(max_batches):
+        out.extend(ds.resolve(ds.dispatch()).progs)
+        counts = np.bincount([sp.prov.op for sp in out], minlength=5)
+        if (counts >= want_each).all():
+            break
+    assert (counts >= want_each).all(), counts
+    return out
+
+
+def test_slab_matches_provenance_replay_every_operator(table):
+    """THE round-trip pin: for every operator, the emitted slab is
+    bit-identical to serialize_for_exec of the provenance-replayed
+    Prog, and the generic slab decoder lifts it to a Prog whose
+    csource repro is byte-identical to the replay's — slab → prog →
+    C repro preserved with no side channel."""
+    _eng, ds, _rand = make_synth(table, batch=64)
+    progs = collect_ops(ds, want_each=2)
+    per_op = {op: 0 for op in range(5)}
+    for sp in progs:
+        ref = sp.materialize()
+        assert slab_words64(sp).tobytes() == serialize_for_exec(ref), \
+            (PS.OP_NAMES[sp.prov.op], sp.prov)
+        if per_op[sp.prov.op] < 3:      # csource compare is pricier
+            q = PS.decode_words(slab_words64(sp), table)
+            assert csource.generate(q) == csource.generate(ref), \
+                PS.OP_NAMES[sp.prov.op]
+            per_op[sp.prov.op] += 1
+    assert all(v >= 1 for v in per_op.values()), per_op
+
+
+def test_host_reference_emit_matches_replay(table):
+    """Spec self-consistency: HostSynth's word emission equals
+    serialize_for_exec of the shared materialize replay."""
+    _eng, ds, _rand = make_synth(table, batch=16)
+    rows, tmpls = ds.snapshot()
+    c2t = ds._h["call2tmpl"]
+    probs = np.ones((table.count, table.count))
+    enabled = np.ones(table.count, bool)
+    hs = PS.HostSynth(list(rows), list(tmpls), c2t, probs, enabled,
+                      max_words=ds.L, max_entries=ds.CO,
+                      gen_max=ds.GMAX, rng=np.random.default_rng(4))
+    seen = set()
+    for _ in range(300):
+        prov = hs.synth_one()
+        words = hs.emit(prov)
+        ref = PS.materialize(prov, list(rows), list(tmpls), ds.L,
+                             ds.CO)
+        assert words.tobytes() == serialize_for_exec(ref), prov
+        seen.add(prov.op)
+    assert seen == {0, 1, 2, 3, 4}, seen
+
+
+# -- distribution equivalence (chi-square, device vs host spec) -------------
+
+
+def _collect_device(ds, nbatches):
+    provs = []
+    for _ in range(nbatches):
+        provs.extend(sp.prov for sp in
+                     ds.resolve(ds.dispatch()).progs)
+    return provs
+
+
+def test_operator_mix_matches_host_mutator_weights(table):
+    """Device op draws follow the host mutator's operator mix
+    (prog.synth.OPERATOR_WEIGHTS) — exact chi-square AND a two-sample
+    test vs the HostSynth reference."""
+    _eng, ds, _rand = make_synth(table, batch=256)
+    provs = _collect_device(ds, 16)
+    N = len(provs)
+    obs_d = np.bincount([p.op for p in provs], minlength=5)
+    p_exp = PS.OPERATOR_WEIGHTS / PS.OPERATOR_WEIGHTS.sum()
+    assert chi2_stat(obs_d, N * p_exp) < chi2_crit(4), obs_d
+    rows, tmpls = ds.snapshot()
+    hs = PS.HostSynth(list(rows), list(tmpls), ds._h["call2tmpl"],
+                      np.ones((table.count,) * 2),
+                      np.ones(table.count, bool),
+                      rng=np.random.default_rng(6))
+    obs_h = np.bincount([hs.synth_one().op for _ in range(N)],
+                        minlength=5)
+    stat, df = chi2_two_sample(obs_d, obs_h)
+    assert stat < chi2_crit(df), (obs_d, obs_h)
+
+
+def test_generate_first_call_distribution(table):
+    """The generate chain's first draw (prev = -1) is the choice-table
+    categorical restricted to enabled calls WITH templates — chi-square
+    vs the exact probabilities, device and host reference both."""
+    eng, ds, _rand = make_synth(table, batch=256)
+    # skewed priorities + restricted enabled set
+    C = table.count
+    rng = np.random.default_rng(2)
+    prios = (rng.random((C, C)).astype(np.float32) * 6 + 1) / 7
+    eng.set_priorities(prios)
+    en_ids = sorted(rng.choice(C, size=C // 2, replace=False).tolist())
+    eng.set_enabled(en_ids)
+    enabled = np.zeros(C, bool)
+    enabled[en_ids] = True
+    c2t = ds._h["call2tmpl"]
+    w = np.where(enabled & (c2t >= 0), 1.0, 0.0)   # prev=-1: flat row
+    p_exp = w / w.sum()
+    live = p_exp > 0
+
+    provs = _collect_device(ds, 24)
+    # provenance carries template ids; invert to call ids (the
+    # template bank maps 1:1 by construction)
+    firsts = [f for f in (_first_gen_cid(pv, c2t) for pv in provs)
+              if f is not None]
+    obs_d = np.bincount(firsts, minlength=len(c2t))
+    N = obs_d.sum()
+    assert N > 300
+    assert (obs_d[~live] == 0).all()
+    df = int(live.sum()) - 1
+    assert chi2_stat(obs_d, N * p_exp) < chi2_crit(df)
+
+    rows, tmpls = ds.snapshot()
+    hs = PS.HostSynth(list(rows), list(tmpls), c2t, prios, enabled,
+                      rng=np.random.default_rng(8))
+    t2c = _tmpl_to_call(c2t)
+    obs_h = np.zeros_like(obs_d)
+    drawn = 0
+    while drawn < N:
+        pv = hs.synth_one()
+        if pv.op == PS.OP_GENERATE and pv.k >= 1:
+            obs_h[t2c[pv.gen_tmpls[0]]] += 1
+            drawn += 1
+    stat, df2 = chi2_two_sample(obs_d, obs_h)
+    assert stat < chi2_crit(df2), (obs_d[live], obs_h[live])
+
+
+def _tmpl_to_call(c2t):
+    t2c = {}
+    for cid, t in enumerate(c2t):
+        if t >= 0:
+            t2c[int(t)] = cid
+    return t2c
+
+
+def _first_gen_cid(prov, c2t):
+    if prov.op != PS.OP_GENERATE or prov.k < 1:
+        return None
+    return _tmpl_to_call(c2t)[prov.gen_tmpls[0]]
+
+
+def test_splice_insert_squash_mutate_index_distributions(table):
+    """Per-operator index draws vs their written-down spec, on a
+    corpus where every row has ncalls=3 so the conditionals are clean:
+    splice cut ~ U[0..3], squash dele ~ U[0..2], insert pos ~
+    biased_rand(4, 5), mutate kind ~ U[0..2].  Device draws are
+    unconditional (independent of the op draw), so every program
+    contributes a sample."""
+    _eng, ds, _rand = make_synth(table, batch=256, rows=6,
+                                 row_ncalls=3)
+    provs = _collect_device(ds, 16)
+    N = len(provs)
+    n1 = 3
+
+    cuts = np.bincount([p.cut for p in provs], minlength=n1 + 1)
+    assert cuts.sum() == N and len(cuts) == n1 + 1
+    assert chi2_stat(cuts, N * np.full(n1 + 1, 1 / (n1 + 1))) \
+        < chi2_crit(n1), cuts
+
+    deles = np.bincount([p.dele for p in provs], minlength=n1)
+    assert chi2_stat(deles, N * np.full(n1, 1 / n1)) \
+        < chi2_crit(n1 - 1), deles
+
+    # biased_rand(n1+1, k=5): P(j) = ((j+1)^5 - j^5) / (n1+1)^5
+    j = np.arange(n1 + 1, dtype=np.float64)
+    p_pos = ((j + 1) ** 5 - j ** 5) / (n1 + 1) ** 5
+    poss = np.bincount([p.pos for p in provs], minlength=n1 + 1)
+    assert chi2_stat(poss, N * p_pos) < chi2_crit(n1), poss
+
+    kinds = np.bincount([p.mut_kind for p in provs], minlength=3)
+    assert chi2_stat(kinds, N * np.full(3, 1 / 3)) < chi2_crit(2), kinds
+
+
+def test_mutate_value_semantics(table):
+    """The three mutate kinds behave like the host const-arg arm:
+    delta edits land within ±16 of the old value (mod mask), bit flips
+    differ in at most one bit, and the edit is confined to the slot's
+    value word."""
+    _eng, ds, _rand = make_synth(table, batch=256)
+    rows, _tmpls = ds.snapshot()
+    checked = 0
+    for _ in range(12):
+        for sp in ds.resolve(ds.dispatch()).progs:
+            pv = sp.prov
+            if pv.op != PS.OP_MUTATE or pv.slot < 0:
+                continue
+            enc = rows[pv.r1]
+            woff, size, _ci = enc.slots[pv.slot]
+            mask = (1 << (8 * size)) - 1
+            old = int(enc.words[woff]) & mask
+            new = pv.mut_val
+            assert new <= mask
+            w64 = slab_words64(sp)
+            assert int(w64[woff]) == new
+            # all other words untouched vs the source row
+            ref = enc.words.copy()
+            ref[woff] = new
+            assert np.array_equal(w64[: enc.nwords], ref)
+            if pv.mut_kind == 1:
+                delta = (new - old) & mask
+                assert delta <= 16 or (mask + 1 - delta) <= 16, \
+                    (old, new, size)
+            elif pv.mut_kind == 2:
+                x = old ^ new
+                assert bin(x).count("1") <= 1, (old, new)
+            checked += 1
+    assert checked > 20
+
+
+# -- compile pin ------------------------------------------------------------
+
+
+def test_compile_pin_1k_mixed_size_batches(table):
+    """CompileCounter pin: 1k synth dispatches across a pow2-bucketed
+    batch-size set with tables GROWING mid-stream compile NOTHING warm
+    — growth rewrites operand contents, never a dispatch signature."""
+    from syzkaller_tpu.vet.runtime import CompileCounter
+
+    eng, ds, rand = make_synth(table, batch=16, rows=4)
+    for b in (16, 32):
+        eng.synth_block(ds.operands(), b, ds.GMAX)   # warm both sizes
+    with CompileCounter() as cc:
+        grown = 0
+        for i in range(1000):
+            b = (16, 32)[i % 2]
+            blk = eng.synth_block(ds.operands(), b, ds.GMAX)
+            if i % 100 == 50 and grown < 8:
+                for _ in range(20):      # generation is random; retry
+                    if ds.add_program(P.generate(rand, table, 5)):
+                        grown += 1
+                        break
+        np.asarray(blk.out32)            # sync the tail
+    assert grown >= 4
+    assert cc.count == 0, cc.events
+
+
+# -- program ring (device→executor direction) -------------------------------
+
+
+def test_prog_ring_write_batch_roundtrip(tmp_path):
+    """The vectorized batch write lands same-bucket slabs contiguously
+    and the reader view returns them bit-exact; ring-full is a counted
+    drop; skip_committed restores writer/reader alignment."""
+    from syzkaller_tpu.ipc import ring as ring_mod
+
+    ring = ring_mod.PcRing.create(str(tmp_path / "prog-ring"),
+                                  data_words=1 << 12, index_slots=64,
+                                  slab_cap=512, min_bucket=128)
+    w = ring_mod.RingWriter(ring)
+    B, K = 6, 128
+    win = np.arange(B * K, dtype=np.uint32).reshape(B, K)
+    lens = np.full(B, 100, np.int64)
+    ok = w.write_batch(win, lens)
+    assert ok.all()
+    rd = ring_mod.RingReader(ring)
+    batch = rd.read_batch()
+    assert batch is not None and batch.n >= 4       # pow2 prefix
+    for i in range(batch.n):
+        assert np.array_equal(batch.win[i, :100], win[i, :100])
+    rd.consume(batch)
+    while rd.pending():
+        b = rd.read_batch()
+        rd.consume(b)
+    # fill until drop: 4096 data words / 128-bucket = 32 slabs
+    big = np.zeros((64, K), np.uint32)
+    ok = w.write_batch(big, np.full(64, K, np.int64))
+    assert not ok.all()
+    assert ring.load(ring_mod.H_DROPPED) > 0
+    # skip_committed advances past committed-but-unread slabs
+    n_skip = ring_mod.skip_committed(ring, 2)
+    assert n_skip == 2
+    assert ring.load(ring_mod.H_CONSUMED) >= 2
+
+
+def test_prog_ring_chaos_cycle(tmp_path):
+    """Both reverse-direction chaos sides: reader killed mid-read
+    re-reads on relaunch; writer killed mid-write leaves exactly one
+    torn slab, skipped and resynced (the presubmit chaos assertion)."""
+    from syzkaller_tpu.resilience import chaos
+
+    out = chaos.run_prog_ring_chaos(str(tmp_path / "prc"))
+    assert out["prog_ring_reader_reread"]
+    assert out["prog_ring_torn_skipped"] == 1
+    assert out["prog_ring_resynced"]
+
+
+@pytest.mark.skipif(os.system("g++ --version > /dev/null 2>&1") != 0,
+                    reason="no g++")
+def test_executor_slab_attach_exec_parity(table, tmp_path):
+    """The slab-attach exec path: programs read straight off the
+    program ring produce the same per-call results as the same
+    programs through shm-in, and the executor consumes exactly one
+    slab per FLAG_PROG_RING exec."""
+    from syzkaller_tpu import ipc
+    from syzkaller_tpu.ipc import ring as ring_mod
+
+    rand = P.Rand(np.random.default_rng(3))
+    env = ipc.Env(flags=ipc.FLAG_COVER | ipc.FLAG_DEDUP_COVER
+                  | ipc.FLAG_FAKE_COVER, prog_ring=True,
+                  workdir=str(tmp_path))
+    try:
+        for trial in range(4):
+            p = P.generate(rand, table, 4)
+            data = serialize_for_exec(p)
+            r_shm = env.exec(p)
+            cons0 = env.prog_ring.load(ring_mod.H_CONSUMED)
+            assert env.prog_writer.write(
+                trial, np.frombuffer(data, np.uint32))
+            r_ring = env.exec(None, from_prog_ring=True)
+            assert env.prog_ring.load(ring_mod.H_CONSUMED) == cons0 + 1
+            assert len(r_ring.calls) == len(r_shm.calls)
+            for a, b in zip(r_shm.calls, r_ring.calls):
+                assert (a.index, a.errno) == (b.index, b.errno)
+                assert np.array_equal(a.cover, b.cover)
+        # no committed slab → retryable status, never a crash
+        r = env.exec(None, from_prog_ring=True)
+        assert r.restarted and not r.failed
+    finally:
+        env.close()
+
+
+# -- the full plane: fuzzer proc loop -----------------------------------
+
+
+@pytest.mark.skipif(os.system("g++ --version > /dev/null 2>&1") != 0,
+                    reason="no g++")
+def test_synth_stream_proc_loop_end_to_end(table):
+    """In-process fuzzer with -device -synth: the proc loop execs
+    device-synthesized programs through the program ring, covers come
+    back through the PC ring, triage admits inputs AND grows the synth
+    corpus table — the fully device-resident exec pipeline closed."""
+    from syzkaller_tpu.fuzzer.fuzzer import Fuzzer
+
+    f = Fuzzer(name="t", manager_addr="127.0.0.1:1", procs=1,
+               descriptions="probe.txt", output_mode="none",
+               use_device=True, npcs=1 << 13, corpus_cap=1 << 10,
+               synth=True, table=table)
+    f.build_call_list([c.name for c in table.calls], None)
+    assert f.synthdev is not None and f.synthdev.n_templates >= 10
+    th = threading.Thread(target=f.proc_loop, args=(0,), daemon=True)
+    th.start()
+    deadline = time.monotonic() + 90
+    try:
+        while time.monotonic() < deadline:
+            vals = f.signal.tstats.values()
+            ds = f.signal.tstats
+            if vals[ds.slot("synth_programs")] >= 32 and \
+                    f.synthdev.n_rows > 0 and len(f.corpus) > 0:
+                break
+            time.sleep(0.5)
+    finally:
+        f.stop()
+        th.join(timeout=60)
+    assert not th.is_alive()
+    vals = f.signal.tstats.values()
+    ds = f.signal.tstats
+    assert vals[ds.slot("synth_batches")] >= 1
+    assert vals[ds.slot("synth_programs")] >= 32
+    assert vals[ds.slot("synth_slabs")] >= 1, "no slabs ringed"
+    assert f.synthdev.n_rows > 0, "triage never grew the synth table"
+    assert len(f.corpus) > 0
+    if f.ct is not None and hasattr(f.ct, "stop"):
+        f.ct.stop()
+
+
+# -- vectorized legacy pack paths (baseline-retirement guards) -------------
+
+
+def test_slabify_vectorized_matches_legacy_semantics():
+    """The vectorized _slabify preserves the legacy per-cover loop's
+    exact output (chunk spreading, empty covers, owner map) — the
+    rewrite that retired its hotpath baseline entries."""
+    from syzkaller_tpu.fuzzer.device_signal import DeviceSignal
+
+    sig = DeviceSignal(ncalls=8, npcs=1 << 12, flush_batch=8,
+                       max_pcs=64, corpus_cap=32)
+    rng = np.random.default_rng(0)
+    covers = [rng.integers(0, 1 << 20, size=n).astype(np.uint32)
+              for n in (0, 3, 64, 65, 200, 1)]
+    win, counts, owner = sig._slabify(covers)
+    K = win.shape[1]
+    # reference: the legacy loop
+    r = 0
+    for i, c in enumerate(covers):
+        c = np.asarray(c, np.uint32)
+        for lo in range(0, max(len(c), 1), K):
+            seg = c[lo: lo + K]
+            assert counts[r] == len(seg)
+            assert owner[r] == i
+            assert np.array_equal(win[r, : len(seg)], seg)
+            r += 1
+    assert (owner[r:] == -1).all()
+    assert (counts[r:] == 0).all()

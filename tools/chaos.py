@@ -58,6 +58,12 @@ def main(argv=None) -> int:
             # (counted, not crashed) and the ring must resync
             out["ring"] = chaos.run_ring_chaos(
                 os.path.join(base, "ring"), verbose=verbose)
+            # synth fold-in, the REVERSE direction (device→executor
+            # program ring): SIGKILL the reader mid-program-slab-read
+            # (re-read proven) and the writer mid-write (torn slab
+            # skipped, new generation flows)
+            out["prog_ring"] = chaos.run_prog_ring_chaos(
+                os.path.join(base, "prog-ring"), verbose=verbose)
         if not args.no_autopilot:
             # the compound-failure cycle: kill 2 of N VM threads + flap
             # the backend + wedge a campaign, autopilot remediates all
