@@ -1104,6 +1104,134 @@ def bench_synth(smoke=False):
     }
 
 
+def bench_sharded(call_ids, pc_idx, valid, npcs=NPCS, seconds=SECONDS,
+                  smoke=False):
+    """Mesh-plane throughput: the SAME update_batch stream through a
+    serial and a PC-axis-sharded engine, timed, with warm recompiles
+    pinned at 0 and the exported frontiers asserted bit-identical (the
+    sharded path must never buy speed with divergence).  Shards over
+    min(8, available) devices; on a 1-device backend it degrades to the
+    serial engine so the JSON schema survives any host."""
+    import jax
+
+    from syzkaller_tpu.cover.engine import CoverageEngine, pc_mesh
+    from syzkaller_tpu.vet.runtime import CompileCounter
+
+    n_dev = 1
+    while n_dev * 2 <= min(8, len(jax.devices())):
+        n_dev *= 2
+    mesh = pc_mesh(n_dev, "") if n_dev > 1 else None
+    nbatch = call_ids.shape[0]
+
+    def run(eng):
+        for bi in range(nbatch):         # warm every batch shape
+            np.asarray(eng.update_batch(call_ids[bi], pc_idx[bi],
+                                        valid[bi]).has_new)
+        with CompileCounter() as cc:
+            n = 0
+            t0 = time.perf_counter()
+            while time.perf_counter() - t0 < seconds:
+                bi = n % nbatch
+                np.asarray(eng.update_batch(
+                    call_ids[bi], pc_idx[bi], valid[bi]).has_new)
+                n += 1
+            dt = time.perf_counter() - t0
+        return call_ids.shape[1] * n / dt, cc.count
+
+    serial = CoverageEngine(npcs=npcs, ncalls=NCALLS, corpus_cap=8)
+    rate_serial, rc_serial = run(serial)
+    if mesh is not None:
+        sharded = CoverageEngine(npcs=npcs, ncalls=NCALLS, corpus_cap=8,
+                                 mesh=mesh)
+        rate_sharded, rc_sharded = run(sharded)
+        a, b = serial.export_state(), sharded.export_state()
+        for key in ("max_cover", "corpus_cover", "flakes"):
+            assert np.array_equal(np.asarray(a[key]),
+                                  np.asarray(b[key])), \
+                f"sharded engine diverged in {key}"
+    else:
+        rate_sharded, rc_sharded = rate_serial, rc_serial
+    return {
+        "signal_diff_prio_updates_per_sec_sharded": round(rate_sharded, 1),
+        "sharded_devices": n_dev,
+        # per-chip efficiency vs ideal linear scaling of the serial
+        # rate (virtual CPU devices share cores, so < 1 here; the
+        # number exists to make TPU-pod runs comparable)
+        "sharded_scaling_per_chip": round(
+            rate_sharded / (rate_serial * n_dev), 3),
+        "sharded_recompiles_warm": rc_sharded + rc_serial,
+    }
+
+
+def bench_hub_sync(nprogs=512, smoke=False):
+    """Hub exchange throughput over the real RPC wire, plus the sketch
+    filter's acceptance numbers: manager A pushes nprogs programs with
+    per-program covered-block sets; manager B's sketch already covers
+    the even half, so the hub must withhold exactly those (filtered)
+    and ship every odd program (a missing one is an exchange false
+    negative — the number this bench pins at 0)."""
+    import shutil
+    import tempfile
+
+    from syzkaller_tpu import rpc as _rpc
+    from syzkaller_tpu.hub.hub import Hub
+    from syzkaller_tpu.mesh.sketch import encode_blocks
+
+    nprogs = 64 if smoke else nprogs
+    rng = np.random.default_rng(31)
+    progs = [bytes(rng.integers(0, 256, size=64, dtype=np.uint8))
+             for _ in range(nprogs)]
+    blocks = [np.arange(i * 4, i * 4 + 4, dtype=np.uint64)
+              for i in range(nprogs)]
+    b_covered = np.concatenate(blocks[0::2])
+
+    workdir = tempfile.mkdtemp(prefix="syz-bench-hub-")
+    hub = Hub(workdir, key="bench")
+    hub.server.serve_background()
+    try:
+        cli = {n: _rpc.RpcClient(hub.addr, timeout=30.0)
+               for n in ("a", "b")}
+        for n, c in cli.items():
+            c.call("Hub.Connect", {"name": n, "key": "bench",
+                                   "fresh": True})
+        t0 = time.perf_counter()
+        # A pushes everything (blocks attached) + its full sketch
+        a_sketch = encode_blocks(np.concatenate(blocks))
+        cli["a"].call("Hub.Sync", {
+            "name": "a", "key": "bench",
+            "add": [_rpc.b64(p) for p in progs],
+            "blocks": [encode_blocks(b) for b in blocks],
+            "sketch": a_sketch, "sketch_reset": True})
+        # B announces the even half as covered, then drains the hub
+        got: list[bytes] = []
+        filtered = 0
+        r = cli["b"].call("Hub.Sync", {
+            "name": "b", "key": "bench", "add": [],
+            "sketch": encode_blocks(b_covered), "sketch_reset": True})
+        while True:
+            got += [_rpc.unb64(p) for p in r["progs"]]
+            filtered += r["filtered"]
+            if not r["more"]:
+                break
+            r = cli["b"].call("Hub.Sync", {"name": "b", "key": "bench",
+                                           "add": []})
+        dt = time.perf_counter() - t0
+        for c in cli.values():
+            c.close()
+    finally:
+        hub.close()
+        shutil.rmtree(workdir, ignore_errors=True)
+
+    want = set(progs[1::2])              # programs carrying new blocks
+    fn = len(want - set(got))            # withheld-but-needed = FN
+    return {
+        "hub_sync_programs_per_sec": round((nprogs + len(got)) / dt, 1),
+        "hub_sketch_filtered": filtered,
+        "hub_sketch_fn": fn,
+        "hub_sync_corpus": nprogs,
+    }
+
+
 def _stage(name):
     sys.stderr.write(f"[bench] {name}\n")
     sys.stderr.flush()
@@ -1211,6 +1339,12 @@ def main(argv=None):
         seconds=0.5 if args.smoke else 2.0, smoke=args.smoke))
     _stage("device program synthesis")
     extras.update(bench_synth(smoke=args.smoke))
+    _stage("sharded engine (mesh plane)")
+    extras.update(bench_sharded(call_ids, pc_idx, valid, npcs=NPCS,
+                                seconds=0.5 if args.smoke else SECONDS,
+                                smoke=args.smoke))
+    _stage("hub exchange (sketch filter)")
+    extras.update(bench_hub_sync(smoke=args.smoke))
     _stage("triage dedup")
     extras.update(bench_triage(np.random.default_rng(17),
                                smoke=args.smoke))

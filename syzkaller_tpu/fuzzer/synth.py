@@ -170,12 +170,21 @@ class DeviceSynth:
             self.tstats.inc("synth_table_rows")
         return True
 
+    # corpus-row-axis tables: sharded over the engine mesh's "pc" axis
+    # (R rows split across devices); the template bank and scalar meta
+    # stay replicated — every device draws from the full bank
+    _ROW_AXIS = ("rows_lo", "rows_hi", "call_off", "ncalls", "slot_off",
+                 "slot_size", "nslots", "call_ids")
+
     def operands(self) -> dict:
         """Fixed-shape device operands, re-put only after growth."""
         with self._mu:
             if self._dev is None:
-                put = self.engine.put_replicated
-                self._dev = {k: put(v) for k, v in self._h.items()}
+                rep = self.engine.put_replicated
+                row = getattr(self.engine, "put_row_sharded", rep)
+                self._dev = {
+                    k: (row(v) if k in self._ROW_AXIS else rep(v))
+                    for k, v in self._h.items()}
             return self._dev
 
     def invalidate_device(self) -> None:

@@ -28,16 +28,20 @@ def summary(hub, start_time: float) -> str:
         new = max(0, len(st.seq) - m.cursor)
         total_added += m.added
         total_new += new
+        age = st.sync_age(name)
+        age_s = "never" if age == float("inf") else f"{age:.0f}s"
         rows.append(f"<tr><td>{html_mod.escape(name)}</td>"
                     f"<td>{m.cursor}</td><td>{m.added}</td>"
-                    f"<td>{new}</td></tr>")
+                    f"<td>{new}</td><td>{m.filtered}</td>"
+                    f"<td>{len(m.covered)}</td><td>{age_s}</td></tr>")
     table = "".join(rows)
     return (f"{_STYLE}<h2>syz-hub</h2>"
             f"<p>uptime {up // 3600}h{(up % 3600) // 60}m, "
             f"corpus {len(st.seq)}, managers {len(st.managers)}, "
             f"added {total_added}, pending {total_new}</p>"
             f"<table><tr><th>manager</th><th>cursor</th><th>added</th>"
-            f"<th>pending</th></tr>{table}</table>"
+            f"<th>pending</th><th>filtered</th><th>covered</th>"
+            f"<th>sync age</th></tr>{table}</table>"
             f"<p><a href='/metrics'>metrics</a> | "
             f"<a href='/log'>log</a></p>")
 
@@ -69,16 +73,13 @@ def serve(hub, host: str, port: int) -> ThreadingHTTPServer:
                                      "charset=utf-8")
                 elif self.path.split("?")[0] == "/healthz":
                     # hub liveness for the same orchestrator probe
-                    # contract as the manager's /healthz: 200 while
-                    # the state plane answers, with the federation
-                    # summary as the body
+                    # contract as the manager's /healthz — 503 when a
+                    # manager's sync age crossed the hub's threshold
+                    # (a stalled exchange drifts the fleet frontiers)
                     import json
-                    st = hub.state
-                    self._send(json.dumps({
-                        "status": "ok",
-                        "corpus": len(st.seq),
-                        "managers": len(st.managers),
-                    }), ctype="application/json")
+                    code, body = hub.health()
+                    self._send(json.dumps(body), code,
+                               ctype="application/json")
                 elif self.path.startswith("/log"):
                     self._send("<pre>%s</pre>" %
                                html_mod.escape(log.cached_log()))

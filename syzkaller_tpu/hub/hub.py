@@ -18,15 +18,20 @@ import time
 
 from syzkaller_tpu import rpc, telemetry
 from syzkaller_tpu.hub.state import HubState
+from syzkaller_tpu.mesh.sketch import decode_blocks
 from syzkaller_tpu.utils import log
 
 
 class Hub:
     def __init__(self, workdir: str, key: str = "",
-                 addr: str = "127.0.0.1:0"):
+                 addr: str = "127.0.0.1:0",
+                 sync_age_threshold: float = 300.0):
         self.key = key
         self.state = HubState(workdir)
         self._mu = threading.Lock()
+        # /healthz goes non-200 when any manager's sync age crosses
+        # this (0 disables the check)
+        self.sync_age_threshold = float(sync_age_threshold)
         # federation stat plane: same typed registry as the manager's,
         # served as /metrics by the hub's HTTP page
         self.registry = telemetry.Registry()
@@ -39,6 +44,9 @@ class Hub:
         self._c_shipped = r.counter(
             "syz_hub_progs_shipped_total",
             "programs shipped to managers on Sync")
+        self._c_filtered = r.counter(
+            "syz_hub_progs_filtered_total",
+            "programs withheld by the covered-block sketch filter")
         self._f_rpc = r.counter(
             "syz_hub_rpc_requests_total", "hub RPC requests by method",
             labels=("method",))
@@ -48,6 +56,26 @@ class Hub:
                 fn=lambda: len(self.state.seq))
         r.gauge("syz_hub_managers", "managers known to the hub",
                 fn=lambda: len(self.state.managers))
+        r.gauge("syz_hub_frontier_blocks",
+                "covered raw-PC blocks in the fleet-wide union frontier",
+                fn=lambda: len(self.state.global_frontier()))
+        # per-manager families: children are registered lazily as
+        # managers appear (loaded state included)
+        self._f_mgr_corpus = r.gauge(
+            "syz_hub_manager_corpus",
+            "programs this manager has contributed to the hub corpus",
+            labels=("manager",))
+        self._f_mgr_age = r.gauge(
+            "syz_hub_sync_age_seconds",
+            "seconds since this manager's last Hub.Sync",
+            labels=("manager",))
+        self._f_mgr_covered = r.gauge(
+            "syz_hub_manager_covered_blocks",
+            "covered raw-PC blocks in this manager's sketch",
+            labels=("manager",))
+        self._gauged: set[str] = set()
+        for name in self.state.managers:
+            self._ensure_manager_gauges(name)
         host, _, port = addr.rpartition(":")
         self.server = rpc.RpcServer(host or "127.0.0.1", int(port or 0))
         self.server.register("Hub.Connect", self.rpc_connect)
@@ -60,6 +88,43 @@ class Hub:
         self._f_rpc.labels(method=method or "?").inc()
         self._h_rpc.observe(seconds)
 
+    def _ensure_manager_gauges(self, name: str) -> None:
+        """Register the per-manager gauge children once per name; the
+        value closures read live hub state so /metrics never goes
+        stale."""
+        if name in self._gauged:
+            return
+        self._gauged.add(name)
+        st = self.state
+        self._f_mgr_corpus.labels(manager=name).set_function(
+            lambda n=name: getattr(st.managers.get(n), "added", 0))
+        self._f_mgr_age.labels(manager=name).set_function(
+            lambda n=name: min(st.sync_age(n), 1e9))
+        self._f_mgr_covered.labels(manager=name).set_function(
+            lambda n=name: len(getattr(st.managers.get(n), "covered",
+                                       ()) or ()))
+
+    def health(self) -> "tuple[int, dict]":
+        """(status_code, body) for /healthz: 503 when any manager that
+        has ever synced now exceeds the sync-age threshold — a stalled
+        exchange means the fleet's frontiers are drifting apart."""
+        stale = {}
+        if self.sync_age_threshold > 0:
+            for name, m in list(self.state.managers.items()):
+                if not m.last_sync:
+                    continue        # connected but never synced yet
+                age = self.state.sync_age(name)
+                if age > self.sync_age_threshold:
+                    stale[name] = round(age, 1)
+        code = 503 if stale else 200
+        return code, {
+            "status": "ok" if code == 200 else "stale_sync",
+            "corpus": len(self.state.seq),
+            "managers": len(self.state.managers),
+            "frontier_blocks": len(self.state.global_frontier()),
+            "stale": stale,
+        }
+
     def _auth(self, params: dict) -> str:
         if self.key and params.get("key") != self.key:
             self._c_auth_failed.inc()
@@ -71,6 +136,7 @@ class Hub:
 
     def rpc_connect(self, params: dict) -> dict:
         name = self._auth(params)
+        self._ensure_manager_gauges(name)
         # the lock covers the in-memory mutation only; staged disk
         # writes flush after release so concurrent managers' syncs
         # don't serialize on file I/O (syz-vet lock pass)
@@ -84,18 +150,44 @@ class Hub:
         return {}
 
     def rpc_sync(self, params: dict) -> dict:
+        """Exchange v2.  v1 fields: name/key/add -> progs/more.  v2
+        adds (all optional, so v1 managers interop unchanged):
+
+          sketch        b64 LE-u64 covered-block DELTA for this manager
+          sketch_reset  bool: `sketch` is a full snapshot (resync after
+                        a manager restore or a detected covered-count
+                        mismatch) — replaces the stored set
+          blocks        list parallel to `add`: each entry the b64
+                        LE-u64 block set of that program ("" = unknown)
+
+        and returns `filtered` (programs the sketch withheld this
+        call) plus `covered` (hub-side sketch size — the echo managers
+        compare against their sent count to detect a hub that lost
+        their sketch and needs a snapshot resync)."""
         name = self._auth(params)
+        self._ensure_manager_gauges(name)
         add = [rpc.unb64(p) for p in params.get("add", [])]
+        blk_wire = params.get("blocks") or []
+        blocks = [decode_blocks(b) if b else None for b in blk_wire] \
+            if blk_wire else None
+        sketch = decode_blocks(params.get("sketch", ""))
         with self._mu:
-            fresh = self.state.add(name, add)
-            progs, more = self.state.pending(name)
+            if len(sketch) or params.get("sketch_reset"):
+                self.state.observe_sketch(
+                    name, sketch, reset=bool(params.get("sketch_reset")))
+            fresh = self.state.add(name, add, blocks)
+            progs, more, filtered = self.state.pending(name)
+            covered = len(self.state.managers[name].covered)
             writes = self.state.take_writes()
         self.state.flush_writes(writes)
         self._c_added.inc(fresh)
         self._c_shipped.inc(len(progs))
-        log.logf(1, "hub: sync %s: +%d fresh, -> %d progs (%d more)",
-                 name, fresh, len(progs), more)
-        return {"progs": [rpc.b64(p) for p in progs], "more": more}
+        self._c_filtered.inc(filtered)
+        log.logf(1, "hub: sync %s: +%d fresh, -> %d progs "
+                 "(%d more, %d sketch-filtered, %d covered blocks)",
+                 name, fresh, len(progs), more, filtered, covered)
+        return {"progs": [rpc.b64(p) for p in progs], "more": more,
+                "filtered": filtered, "covered": covered}
 
     def serve_background(self) -> None:
         self.server.serve_background()
@@ -111,11 +203,15 @@ def main(argv=None):
                     help="status page address, e.g. 127.0.0.1:7789")
     ap.add_argument("-key", default="")
     ap.add_argument("-workdir", default="./hub-workdir")
+    ap.add_argument("-sync-age", type=float, default=300.0,
+                    help="/healthz goes 503 when a manager's sync age "
+                         "exceeds this many seconds (0 disables)")
     ap.add_argument("-v", type=int, default=0)
     args = ap.parse_args(argv)
     log.set_verbosity(args.v)
     log.enable_log_caching()
-    hub = Hub(args.workdir, args.key, args.addr)
+    hub = Hub(args.workdir, args.key, args.addr,
+              sync_age_threshold=args.sync_age)
     log.logf(0, "hub listening on %s:%d", *hub.addr)
     hub.server.serve_background()
     if args.http:

@@ -5,6 +5,16 @@ content-deduplicated corpus persisted as an append-ordered directory,
 per-manager sequence cursors (each manager pulls only what it hasn't
 seen), and call-set filtering so managers only receive programs whose
 calls they can execute.
+
+Exchange v2 (frontier-aware, mesh/sketch.py): managers attach each
+pushed program's covered raw-PC BLOCKS and delta-sync their own
+covered-block sketch; `pending` then skips programs whose every block
+the puller already covers.  The filter's error is strictly one-sided —
+a skipped program can never carry a block the manager lacks, because
+covered sets only grow (so advancing the cursor past a filtered entry
+is safe forever), while programs with unknown block sets always ship.
+Sketches are persisted beside the manager meta so a hub restart keeps
+filtering instead of regressing to naive ship-everything.
 """
 
 from __future__ import annotations
@@ -12,8 +22,12 @@ from __future__ import annotations
 import hashlib
 import json
 import os
+import time
 from dataclasses import dataclass, field
 
+import numpy as np
+
+from syzkaller_tpu.mesh.sketch import should_ship
 from syzkaller_tpu.prog.encoding import call_set
 from syzkaller_tpu.utils import log
 
@@ -24,9 +38,15 @@ class ManagerState:
     cursor: int = 0                  # index into the global sequence
     calls: "set[str] | None" = None  # None = accepts everything
     added: int = 0
+    filtered: int = 0                # programs withheld by the sketch
+    last_sync: float = 0.0           # wall clock of the last Hub.Sync
+    # covered raw-PC blocks (the manager's sketch); persisted as a
+    # sidecar, not in the JSON meta (it is a large flat u64 set)
+    covered: "set[int]" = field(default_factory=set)
 
     def to_json(self) -> dict:
         return {"cursor": self.cursor, "added": self.added,
+                "filtered": self.filtered, "last_sync": self.last_sync,
                 "calls": sorted(self.calls) if self.calls is not None else None}
 
 
@@ -35,11 +55,15 @@ class HubState:
         self.dir = dirpath
         self.corpus_dir = os.path.join(dirpath, "corpus")
         self.mgr_dir = os.path.join(dirpath, "managers")
+        self.blocks_dir = os.path.join(dirpath, "blocks")
         os.makedirs(self.corpus_dir, exist_ok=True)
         os.makedirs(self.mgr_dir, exist_ok=True)
+        os.makedirs(self.blocks_dir, exist_ok=True)
         # global sequence: list of (sig, data); order = admission order
         self.seq: list[tuple[str, bytes]] = []
         self.sigs: set[str] = set()
+        # sig -> covered raw-PC blocks (uint64), when the pusher sent them
+        self.blocks: dict[str, np.ndarray] = {}
         self.managers: dict[str, ManagerState] = {}
         self._writes: list[tuple[str, bytes]] = []   # staged disk writes
         self._load()
@@ -61,20 +85,40 @@ class HubState:
         for _seqno, sig, data in sorted(entries):
             self.seq.append((sig, data))
             self.sigs.add(sig)
+        for name in os.listdir(self.blocks_dir):
+            if name not in self.sigs:
+                continue
+            try:
+                with open(os.path.join(self.blocks_dir, name), "rb") as f:
+                    self.blocks[name] = np.frombuffer(f.read(), "<u8").copy()
+            except OSError:
+                continue
         for name in os.listdir(self.mgr_dir):
             path = os.path.join(self.mgr_dir, name)
+            if name.endswith(".covered"):
+                continue
             try:
                 with open(path) as f:
                     meta = json.load(f)
             except (OSError, json.JSONDecodeError):
                 continue
-            self.managers[name] = ManagerState(
+            m = ManagerState(
                 name=name, cursor=int(meta.get("cursor", 0)),
                 calls=set(meta["calls"]) if meta.get("calls") is not None else None,
-                added=int(meta.get("added", 0)))
+                added=int(meta.get("added", 0)),
+                filtered=int(meta.get("filtered", 0)),
+                last_sync=float(meta.get("last_sync", 0.0)))
+            try:
+                with open(path + ".covered", "rb") as f:
+                    m.covered = set(
+                        np.frombuffer(f.read(), "<u8").tolist())
+            except OSError:
+                pass
+            self.managers[name] = m
         if self.seq:
-            log.logf(0, "hub: loaded %d corpus entries, %d managers",
-                     len(self.seq), len(self.managers))
+            log.logf(0, "hub: loaded %d corpus entries (%d with block "
+                     "sketches), %d managers", len(self.seq),
+                     len(self.blocks), len(self.managers))
 
     # Mutators stage disk writes instead of performing them: the hub's
     # RPC handlers hold the hub lock around the in-memory mutation, and
@@ -114,12 +158,24 @@ class HubState:
         self.managers[name] = m
         self._stage_manager(m)
 
-    def add(self, name: str, progs: list[bytes]) -> int:
-        """Programs pushed by a manager; returns how many were fresh."""
+    def add(self, name: str, progs: list[bytes],
+            blocks: "list[np.ndarray | None] | None" = None) -> int:
+        """Programs pushed by a manager (with optional per-program
+        covered-block arrays, parallel to `progs`); returns how many
+        were fresh."""
         m = self.managers.setdefault(name, ManagerState(name=name))
         fresh = 0
-        for data in progs:
+        for i, data in enumerate(progs):
             sig = hashlib.sha1(data).hexdigest()
+            bl = blocks[i] if blocks is not None and i < len(blocks) \
+                else None
+            if bl is not None and len(bl) and sig not in self.blocks:
+                # a known program gaining a block sketch still helps:
+                # it becomes filterable for future pulls
+                self.blocks[sig] = np.asarray(bl, np.uint64)
+                self._writes.append((
+                    os.path.join(self.blocks_dir, sig),
+                    self.blocks[sig].astype("<u8").tobytes()))
             if sig in self.sigs:
                 continue
             self.sigs.add(sig)
@@ -132,12 +188,38 @@ class HubState:
         self._stage_manager(m)
         return fresh
 
+    def observe_sketch(self, name: str, blocks,
+                       reset: bool = False) -> int:
+        """Fold a manager's covered-block delta (or full snapshot when
+        `reset`) into its sketch; returns blocks newly covered.  The
+        sketch is staged to a sidecar so a hub restart keeps
+        filtering."""
+        m = self.managers.setdefault(name, ManagerState(name=name))
+        if reset:
+            m.covered = set()
+        before = len(m.covered)
+        m.covered.update(int(b) for b in np.asarray(blocks,
+                                                    np.uint64).ravel())
+        new = len(m.covered) - before
+        if new or reset:
+            self._writes.append((
+                os.path.join(self.mgr_dir, f"{name}.covered"),
+                np.array(sorted(m.covered),
+                         np.uint64).astype("<u8").tobytes()))
+        return new
+
     def pending(self, name: str, max_progs: int = 100
-                ) -> tuple[list[bytes], int]:
-        """Programs this manager hasn't seen (call-set filtered), plus a
-        count of how many more are waiting (ref Sync's More field)."""
+                ) -> tuple[list[bytes], int, int]:
+        """Programs this manager hasn't seen (call-set AND sketch
+        filtered), a count of how many more are waiting (ref Sync's
+        More field), and how many the sketch withheld this call.  A
+        withheld program's every block is already covered by the
+        puller, and covered sets only grow — so the cursor advances
+        past it permanently without ever creating an exchange false
+        negative."""
         m = self.managers.setdefault(name, ManagerState(name=name))
         out: list[bytes] = []
+        filtered = 0
         while m.cursor < len(self.seq) and len(out) < max_progs:
             sig, data = self.seq[m.cursor]
             m.cursor += 1
@@ -147,7 +229,28 @@ class HubState:
                         continue
                 except Exception:
                     continue
+            if m.covered and not should_ship(self.blocks.get(sig),
+                                             m.covered):
+                filtered += 1
+                m.filtered += 1
+                continue
             out.append(data)
         more = len(self.seq) - m.cursor
+        m.last_sync = time.time()
         self._stage_manager(m)
-        return out, more
+        return out, more, filtered
+
+    def sync_age(self, name: str) -> float:
+        """Seconds since the manager's last Hub.Sync (inf if never)."""
+        m = self.managers.get(name)
+        if m is None or not m.last_sync:
+            return float("inf")
+        return max(0.0, time.time() - m.last_sync)
+
+    def global_frontier(self) -> "set[int]":
+        """The fleet-wide covered-block union — what 'N managers
+        converge one global frontier' means at hub granularity."""
+        out: set[int] = set()
+        for m in self.managers.values():
+            out |= m.covered
+        return out

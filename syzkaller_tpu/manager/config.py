@@ -16,8 +16,11 @@ from dataclasses import asdict, dataclass, field
 from syzkaller_tpu.sys.table import SyscallTable
 
 
-class ConfigError(Exception):
-    pass
+class ConfigError(ValueError):
+    """Configuration rejected.  Subclasses ValueError so callers (and
+    tests) that guard config-shaped failures with the broader type —
+    e.g. `pc_mesh` refusing a mesh larger than the addressable device
+    slice — keep working."""
 
 
 @dataclass
@@ -58,6 +61,14 @@ class Config:
     #                                    BASELINE config #4's device mesh)
     mesh_platform: str = ""            # pin mesh devices to a platform
     #                                    ("cpu" = virtual-device mesh)
+    # pod-scale mesh plane (multi-process topology)
+    mesh_hosts: int = 1                # manager processes in the pod
+    #                                    slice (jax.distributed world
+    #                                    size); 1 = single-process mesh
+    mesh_devices_per_host: int = 0     # devices each process addresses
+    #                                    (0 = derive mesh / mesh_hosts);
+    #                                    the engine shards over THIS
+    #                                    process's slice only
     campaigns: list = field(default_factory=list)
     #                                  # stateful-subsystem campaigns to
     #                                    rotate fuzzer connections over
@@ -135,6 +146,12 @@ class Config:
     # federation (syz-hub)
     hub_addr: str = ""
     hub_key: str = ""
+    hub_sync_interval: float = 60.0    # Hub.Sync cadence in seconds
+    hub_sketch: bool = True            # publish the covered-block
+    #                                    sketch so the hub ships only
+    #                                    programs plausibly carrying
+    #                                    new signal (False = naive full
+    #                                    exchange)
 
     _BUILTIN_SUPPRESSIONS = [
         rb"panic: failed to start executor binary",
@@ -178,6 +195,31 @@ class Config:
             raise ConfigError("lkvm requires kernel")
         if self.mesh < 0:
             raise ConfigError(f"invalid mesh {self.mesh}")
+        if self.mesh_hosts < 1:
+            raise ConfigError(
+                f"invalid mesh_hosts {self.mesh_hosts} (>= 1)")
+        if self.mesh_devices_per_host < 0:
+            raise ConfigError(
+                f"invalid mesh_devices_per_host "
+                f"{self.mesh_devices_per_host}")
+        if self.mesh_hosts > 1 or self.mesh_devices_per_host:
+            if self.mesh < 2:
+                raise ConfigError(
+                    "mesh_hosts/mesh_devices_per_host require mesh >= 2")
+            if self.mesh_devices_per_host:
+                if self.mesh != self.mesh_hosts * self.mesh_devices_per_host:
+                    raise ConfigError(
+                        f"mesh {self.mesh} != mesh_hosts {self.mesh_hosts}"
+                        f" * mesh_devices_per_host "
+                        f"{self.mesh_devices_per_host}")
+            elif self.mesh % self.mesh_hosts:
+                raise ConfigError(
+                    f"mesh {self.mesh} not divisible by mesh_hosts "
+                    f"{self.mesh_hosts}; set mesh_devices_per_host "
+                    "explicitly for uneven slices")
+        if self.hub_sync_interval <= 0:
+            raise ConfigError(
+                f"invalid hub_sync_interval {self.hub_sync_interval}")
         if not 0 <= self.admit_batch <= 4096:
             raise ConfigError(
                 f"invalid admit_batch {self.admit_batch} (0..4096)")

@@ -64,6 +64,17 @@ class FuzzerConn:
     calls: list = field(default_factory=list)
 
 
+def _wire_blocks(cover) -> str:
+    """Raw-PC cover -> covered-block wire string ('' when empty/bad);
+    stored on the CorpusItem so hub sync ships it with the program."""
+    try:
+        from syzkaller_tpu.mesh.sketch import blocks_of, encode_blocks
+        b = blocks_of(cover)
+        return encode_blocks(b) if len(b) else ""
+    except Exception:
+        return ""
+
+
 @dataclass
 class CorpusItem:
     data: bytes
@@ -71,6 +82,9 @@ class CorpusItem:
     call_index: int
     corpus_row: int = -1
     trace_id: str = ""      # admitting input's trace (crash lineage)
+    # covered raw-PC blocks (mesh/sketch.py wire string) — shipped with
+    # the program on hub sync so the hub can frontier-filter pulls
+    blocks: str = ""
 
 
 class Manager:
@@ -99,15 +113,20 @@ class Manager:
 
         # the config `mesh` knob shards the engine's PC axis over N
         # devices (BASELINE config #4: device-resident global coverage
-        # matrix with on-mesh merges); 0/1 keeps a single-device engine
-        mesh = None
-        if cfg.mesh >= 2:
-            from syzkaller_tpu.cover.engine import pc_mesh
-            mesh = pc_mesh(cfg.mesh, cfg.mesh_platform)
+        # matrix with on-mesh merges); 0/1 keeps a single-device engine.
+        # Under a pod topology (`mesh_hosts` > 1) mesh_from_config
+        # brings up jax.distributed first and shards over THIS
+        # process's addressable slice.
+        from syzkaller_tpu.mesh.dist import mesh_from_config
+        mesh = mesh_from_config(cfg)
         self.engine = CoverageEngine(
             npcs=cfg.npcs, ncalls=self.table.count,
             corpus_cap=cfg.corpus_cap, batch=cfg.flush_batch, mesh=mesh,
             telemetry=self.device_stats)
+        if mesh is not None:
+            # the triage similarity matmul rides the same mesh (report
+            # batch row-sharded; labels bit-exact either way)
+            self.crash_index.kernel.shard(mesh)
         if cfg.backend_failover:
             # the resilience supervisor: device dispatch faults
             # quarantine the backend, migrate engine state to a
@@ -171,6 +190,11 @@ class Manager:
         self._instances: dict[int, vm.Instance] = {}
         self._hub_client: "rpc.RpcClient | None" = None
         self._hub_synced: set[bytes] = set()
+        # frontier-aware exchange v2: delta cursor into the PcMap's
+        # append-only key order (blocks up to here are published) and
+        # the block ids already sent, for hub-loss detection/resync
+        self._hub_sketch_sent = 0
+        self._hub_blocks_sent: set[int] = set()
         self._repro_active: set[str] = set()
         self._repro_block = 0          # unique index block per repro job
         # ONE shared batched-bisection service + VM pool for every
@@ -345,6 +369,18 @@ class Manager:
             return
         if st.corrupt_skipped:
             self._c_snapshot_corrupt.inc(st.corrupt_skipped)
+        # shard-layout stamp: host-canonical arrays restore into any
+        # mesh shape (import_state re-shards on ingest), but a layout
+        # change is worth an operator-visible line
+        snap_layout = getattr(st, "shard_layout", None) or {}
+        snap_devs = int(snap_layout.get("devices", 1))
+        cur_mesh = getattr(self.engine, "mesh", None)
+        cur_devs = (int(np.prod(cur_mesh.devices.shape))
+                    if cur_mesh is not None else 1)
+        if snap_devs != cur_devs:
+            log.logf(0, "snapshot shard layout %d device(s) -> current "
+                     "mesh %d device(s); re-sharding on ingest",
+                     snap_devs, cur_devs)
         try:
             # the PcMap key order first: restored bitmap indices mean
             # the PCs the crashed manager assigned them to.  Preseeding
@@ -899,7 +935,8 @@ class Manager:
                 self.corpus[sig] = CorpusItem(
                     data=data, call=call, call_index=call_index,
                     corpus_row=row,
-                    trace_id=trace.trace_id if trace is not None else "")
+                    trace_id=trace.trace_id if trace is not None else "",
+                    blocks=_wire_blocks(cover))
                 self._c_new_inputs.inc()
                 self._e_admit_rate.add(1)
                 # broadcast to the other fuzzers (ref manager.go:596-621)
@@ -930,7 +967,8 @@ class Manager:
         self.corpus[p.sig] = CorpusItem(
             data=p.data, call=p.call, call_index=p.call_index,
             corpus_row=row,
-            trace_id=p.trace.trace_id if p.trace is not None else "")
+            trace_id=p.trace.trace_id if p.trace is not None else "",
+            blocks=_wire_blocks(p.cover))
         wire = {"prog": p.wire_prog, "call": p.call,
                 "call_index": p.call_index, "cover": p.wire_cover}
         for other, conn in self.fuzzers.items():
@@ -967,37 +1005,93 @@ class Manager:
 
     # -- hub federation (ref manager.go:658-736) ---------------------------
 
+    def _hub_sketch_delta(self) -> "tuple[str, bool]":
+        """(wire sketch, reset) for this sync: the covered-block delta
+        since the last publish, derived from the PcMap's append-only
+        first-seen key order.  Sends a full snapshot (reset) on the
+        first publish after (re)connect so a restored manager or a hub
+        that lost our sketch re-aligns instead of staying stale."""
+        from syzkaller_tpu.mesh.sketch import blocks_of, encode_blocks
+        keys = self.pcmap.export_keys()
+        reset = self._hub_sketch_sent == 0 and len(self._hub_blocks_sent) == 0
+        fresh = blocks_of(keys if reset else keys[self._hub_sketch_sent:])
+        self._hub_sketch_sent = len(keys)
+        new = [int(b) for b in fresh if int(b) not in self._hub_blocks_sent]
+        self._hub_blocks_sent.update(new)
+        if not new and not reset:
+            return "", False
+        import numpy as _np
+        return encode_blocks(_np.array(sorted(new), _np.uint64)), reset
+
     def hub_sync_once(self) -> None:
-        """Push corpus programs the hub hasn't seen; pull fresh ones as
-        candidates (coverage state is rebuilt locally by re-triage)."""
+        """Push corpus programs the hub hasn't seen (with their
+        covered-block sets) and this manager's sketch delta; pull fresh
+        ones as candidates (coverage state is rebuilt locally by
+        re-triage).  The hub withholds programs whose every block we
+        already cover — exchange v2 ships only plausible new signal.
+        Pulls are drained in batches while the hub reports more
+        pending, so a freshly-joined manager converges in one sync."""
         if self._hub_client is None:
             self._hub_client = rpc.RpcClient(self.cfg.hub_addr)
             self._hub_client.call("Hub.Connect", {
                 "name": self.cfg.name, "key": self.cfg.hub_key,
                 "fresh": len(self.corpus) == 0,
                 "calls": self.enabled_names})
+            # new connection: re-publish the full sketch next
+            self._hub_sketch_sent = 0
+            self._hub_blocks_sent = set()
         with self._mu:
-            new = [it.data for sig, it in self.corpus.items()
-                   if sig not in self._hub_synced]
+            fresh_items = [it for sig, it in self.corpus.items()
+                           if sig not in self._hub_synced]
+            new = [it.data for it in fresh_items]
+            blocks = [it.blocks for it in fresh_items]
             for sig in self.corpus:
                 self._hub_synced.add(sig)
-        r = self._hub_client.call("Hub.Sync", {
-            "name": self.cfg.name, "key": self.cfg.hub_key,
-            "add": [rpc.b64(d) for d in new]})
-        pulled = 0
-        for pd in r.get("progs", []):
-            data = rpc.unb64(pd)
-            sig = hashlib.sha1(data).digest()
-            with self._mu:
-                if sig in self.corpus:
-                    continue
-                self.candidates.append(data)
-                pulled += 1
-        if new or pulled:
-            log.logf(0, "hub sync: sent %d, received %d (%d more)",
-                     len(new), pulled, int(r.get("more", 0)))
+        req = {"name": self.cfg.name, "key": self.cfg.hub_key,
+               "add": [rpc.b64(d) for d in new]}
+        if self.cfg.hub_sketch:
+            req["blocks"] = blocks
+            sketch, reset = self._hub_sketch_delta()
+            if sketch:
+                req["sketch"] = sketch
+            if reset:
+                req["sketch_reset"] = True
+        pulled = filtered = 0
+        rounds = 0
+        while True:
+            r = self._hub_client.call("Hub.Sync", req)
+            filtered += int(r.get("filtered", 0))
+            for pd in r.get("progs", []):
+                data = rpc.unb64(pd)
+                sig = hashlib.sha1(data).digest()
+                with self._mu:
+                    if sig in self.corpus:
+                        continue
+                    self.candidates.append(data)
+                    pulled += 1
+            covered = r.get("covered")
+            if self.cfg.hub_sketch and covered is not None \
+                    and covered < len(self._hub_blocks_sent):
+                # the hub lost (part of) our sketch — snapshot-resync
+                # on the next sync instead of drifting into stale FPs
+                log.logf(0, "hub sync: covered echo %d < sent %d; "
+                         "scheduling sketch resync", int(covered),
+                         len(self._hub_blocks_sent))
+                self._hub_sketch_sent = 0
+                self._hub_blocks_sent = set()
+            rounds += 1
+            if not int(r.get("more", 0)) or rounds >= 50:
+                break
+            # drain the backlog: pushes/sketch went with round one
+            req = {"name": self.cfg.name, "key": self.cfg.hub_key,
+                   "add": []}
+        if new or pulled or filtered:
+            log.logf(0, "hub sync: sent %d, received %d "
+                     "(%d sketch-filtered, %d more)", len(new), pulled,
+                     filtered, int(r.get("more", 0)))
 
     def hub_sync_loop(self) -> None:
+        interval = max(1, int(round(self.cfg.hub_sync_interval)))
         while not self._stop:
             try:
                 self.hub_sync_once()
@@ -1006,10 +1100,10 @@ class Manager:
                 if self._hub_client is not None:
                     self._hub_client.close()
                     self._hub_client = None
-            for _ in range(60):
+            for _ in range(interval):
                 if self._stop:
                     return
-                time.sleep(1.0)
+                time.sleep(min(1.0, self.cfg.hub_sync_interval))
 
     # -- corpus minimization (ref manager.go:504-550) ----------------------
 

@@ -1,0 +1,27 @@
+"""Pod-scale mesh plane: the subsystem that crosses the host boundary
+in both directions.
+
+  * `dist` — jax.distributed init seam + process-local mesh topology
+    (`mesh_from_config` is the production entry the manager builds its
+    engine mesh through) and cross-host SparseView frontier spanning.
+  * `sketch` — the covered-block coverage sketch the hub's frontier-
+    aware corpus exchange keys on (exact delta-synced sets: provably
+    zero false negatives, see the module docstring for why a bloom has
+    the WRONG one-sided error here).
+  * `fleet` — one autopilot over N managers + the hub, composed from
+    the existing HttpSource/ReportExecutor seam.
+"""
+
+from syzkaller_tpu.mesh.dist import (
+    absorb_frontiers, export_frontiers, init_distributed,
+    mesh_from_config, process_topology)
+from syzkaller_tpu.mesh.fleet import FleetAutopilot, HubWatch
+from syzkaller_tpu.mesh.sketch import (
+    BLOCK_SHIFT, BlockSketch, blocks_of, decode_blocks, encode_blocks)
+
+__all__ = [
+    "BLOCK_SHIFT", "BlockSketch", "FleetAutopilot", "HubWatch",
+    "absorb_frontiers", "blocks_of", "decode_blocks", "encode_blocks",
+    "export_frontiers", "init_distributed", "mesh_from_config",
+    "process_topology",
+]

@@ -1,0 +1,153 @@
+"""Multi-process mesh topology: the jax.distributed init seam and the
+process-local device-slice math behind the config's `mesh_hosts` /
+`mesh_devices_per_host` knobs.
+
+On a pod slice every manager process addresses only its own chips;
+`jax.distributed.initialize` forms the global device view (SNIPPETS.md
+pjit exemplar: "on multi-process platforms such as TPU pods, pjit can
+be used to run computations across all available devices across
+processes").  The engine's bitmap shards over the PROCESS-LOCAL slice
+(elementwise diff/merge never needed to cross hosts — the PC axis plan
+of SURVEY §5), and the cross-host direction rides the hub's frontier-
+aware program exchange (mesh/sketch.py): programs + covered-block
+sketches are the durable state the per-host matrices are rebuilt from.
+
+CPU-backend caveat (pinned by tools/mesh_smoke.py): jaxlib through at
+least 0.4.37 forms the global multi-process device view on the CPU
+backend but rejects cross-process COMPUTATIONS ("Multiprocess
+computations aren't implemented on the CPU backend"), so CI validates
+the init handshake + the process-local slice + sharded-vs-serial
+bit-exactness per process; global-collective dispatches are a TPU-pod
+runtime path behind the same seam.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+
+import numpy as np
+
+from syzkaller_tpu.utils import log
+
+_init_mu = threading.Lock()
+_initialized = False
+
+
+def init_distributed(coordinator: str = "", num_processes: int = 0,
+                     process_id: int = -1) -> bool:
+    """Idempotent jax.distributed bring-up.  Arguments fall back to the
+    SYZ_MESH_COORDINATOR / SYZ_MESH_NPROCS / SYZ_MESH_PROC env seam so
+    orchestrators can inject topology without touching the config file.
+    Returns True when a multi-process runtime is (now) active, False
+    for the single-process fallback (missing topology is NOT an error:
+    a 1-host config runs the same code)."""
+    global _initialized
+    import jax
+
+    coordinator = coordinator or os.environ.get("SYZ_MESH_COORDINATOR", "")
+    num_processes = num_processes or int(
+        os.environ.get("SYZ_MESH_NPROCS", "0"))
+    if process_id < 0:
+        process_id = int(os.environ.get("SYZ_MESH_PROC", "-1"))
+    with _init_mu:
+        # NB: the already-up probe must not touch jax.process_count()
+        # — that initializes the backend, after which
+        # jax.distributed.initialize refuses to run at all
+        from jax._src import distributed as _dist
+        if _initialized or getattr(_dist.global_state, "client",
+                                   None) is not None:
+            _initialized = True
+            return True
+        if not coordinator or num_processes < 2 or process_id < 0:
+            return False
+        # jax 0.4.x keyword is process_id (NOT process_index)
+        jax.distributed.initialize(coordinator_address=coordinator,
+                                   num_processes=num_processes,
+                                   process_id=process_id)
+        _initialized = True
+        log.logf(0, "mesh: distributed runtime up — process %d/%d, "
+                 "%d local / %d global devices", jax.process_index(),
+                 jax.process_count(), len(jax.local_devices()),
+                 len(jax.devices()))
+        return True
+
+
+def process_topology() -> dict:
+    """The topology snapshot tests/smokes assert on (and /metrics could
+    export): process index/count plus local/global device counts."""
+    import jax
+
+    return {
+        "process_index": jax.process_index(),
+        "process_count": jax.process_count(),
+        "local_devices": len(jax.local_devices()),
+        "global_devices": len(jax.devices()),
+    }
+
+
+def local_mesh_size(cfg) -> int:
+    """How many devices THIS process's engine mesh spans: the whole
+    `mesh` knob single-process, the per-host slice under a pod
+    topology.  Pure arithmetic — validated by Config.validate, no
+    accelerator runtime touched."""
+    if cfg.mesh < 2:
+        return cfg.mesh
+    if cfg.mesh_devices_per_host:
+        return cfg.mesh_devices_per_host
+    return cfg.mesh // max(1, cfg.mesh_hosts)
+
+
+def mesh_from_config(cfg):
+    """The manager's engine-mesh entry point: bring up the distributed
+    runtime when topology is configured (or injected via env), then
+    build the PC-axis mesh over this process's addressable slice.
+    Returns None for unmeshed configs.  Raises manager.config's
+    ConfigError (via pc_mesh) when the slice is too small — a clear
+    startup failure, not a mid-dispatch XLA crash."""
+    if cfg.mesh < 2:
+        return None
+    from syzkaller_tpu.cover.engine import pc_mesh
+
+    if cfg.mesh_hosts > 1:
+        init_distributed(num_processes=cfg.mesh_hosts)
+    n = local_mesh_size(cfg)
+    return pc_mesh(n, cfg.mesh_platform)
+
+
+# -- cross-host frontier spanning -------------------------------------------
+#
+# Per-campaign SparseView frontiers are host-side block dicts over the
+# DENSE bitmap space, whose indices are PcMap first-seen key order —
+# so spanning them across hosts is exact only between managers with
+# aligned key orders (a preseeded PcMap: the vmlinux cover scan, or
+# export_keys/preseed as the chaos/equivalence harnesses do).  The
+# helpers below are that spanning seam; block-granular GLOBAL frontier
+# convergence for unaligned managers rides the hub sketch instead
+# (raw-PC blocks are key-order independent).
+
+
+def export_frontiers(engine) -> dict:
+    """{tag: (block ids, slabs)} for every live campaign frontier —
+    the wire/snapshot form (SparseView.export_blocks)."""
+    return {tag: v.export_blocks()
+            for tag, v in engine.frontier_views().items()}
+
+
+def absorb_frontiers(engine, fronts: dict) -> None:
+    """OR peer frontier exports into this engine's views (creating
+    them on first sight).  Caller guarantees key-order alignment."""
+    for tag, (ids, data) in fronts.items():
+        engine.frontier_view(tag).import_blocks(ids, data)
+
+
+def spanned_popcount(engines) -> int:
+    """Bits lit across a set of engines' merged frontier views — the
+    'N hosts converge one global frontier' acceptance probe."""
+    from syzkaller_tpu.cover.engine import merge_views
+
+    views = [v for e in engines for v in e.frontier_views().values()]
+    if not views:
+        return 0
+    dense = merge_views(views)
+    return int(np.unpackbits(dense.view(np.uint8)).sum())

@@ -1,0 +1,236 @@
+"""Fleet-level autopilot: ONE controller over N managers plus the hub,
+composed from the existing per-manager control loop (autopilot/
+controller.py) through its HttpSource/ReportExecutor seam.
+
+Each managed host runs a full per-manager Autopilot in observe mode
+(the manager's own in-process autopilot executes; this controller
+watches the fleet).  On top of the per-host loops the fleet layer adds
+the decisions only a cross-host view can make:
+
+  * per-host health roll-up — an unreachable /metrics endpoint is
+    itself a health signal (HOST_DOWN), not an exception;
+  * shard-aware pool targeting — VM capacity per coverage shard, so a
+    host driving an 8-chip slice isn't starved to the same VM count as
+    a 2-chip one, with rebalance recommendations when a host's
+    VMs-per-shard deviates from the fleet;
+  * rotation arbitration — at most one campaign rotation recommendation
+    per tick, aimed at the host with the weakest frontier productivity
+    (N hosts all rotating at once would thrash the global frontier);
+  * hub-exchange watchdog — the federation tier's liveness: a manager
+    whose hub sync age exceeds the threshold, or a hub shipping nothing
+    while programs are pending, is flagged before the frontiers drift.
+
+Everything here is observe/recommend (ReportExecutor semantics): the
+fleet controller has no remote seams to act through, and the per-host
+autopilots already execute locally.  `tools/autopilot.py --fleet`
+drives it; `health_json` keeps the PR 10 probe contract at L8.
+"""
+
+from __future__ import annotations
+
+import time
+
+from syzkaller_tpu.autopilot.controller import Autopilot, ReportExecutor
+from syzkaller_tpu.autopilot.health import State
+from syzkaller_tpu.autopilot.policy import SampleView
+
+HOST_DOWN = "host_down"
+SYNC_STALLED = "hub_sync_stalled"
+SHIP_STALLED = "hub_ship_stalled"
+
+
+class HubWatch:
+    """Hub-exchange-rate watchdog over the hub's /metrics: flags
+    managers whose sync age crossed the threshold and a hub that has
+    pending programs but ships none between ticks."""
+
+    def __init__(self, source, sync_age_threshold: float = 300.0):
+        self.source = source
+        self.sync_age_threshold = float(sync_age_threshold)
+        self._prev: "dict | None" = None
+
+    def check(self) -> dict:
+        sample = self.source.sample()
+        prev, self._prev = self._prev, sample
+        view = SampleView(sample, prev)
+        flags = []
+        for key, val in sample.items():
+            if key.startswith("syz_hub_sync_age_seconds") \
+                    and val > self.sync_age_threshold:
+                flags.append({"issue": SYNC_STALLED, "series": key,
+                              "age": round(val, 1)})
+        shipped = view.delta("syz_hub_progs_shipped_total")
+        added = view.delta("syz_hub_progs_added_total")
+        if prev is not None and added > 0 and shipped == 0 \
+                and (sample.get("syz_hub_managers", 0) or 0) >= 2:
+            flags.append({"issue": SHIP_STALLED,
+                          "added": added, "shipped": shipped})
+        return {
+            "corpus": sample.get("syz_hub_corpus_size", 0),
+            "managers": sample.get("syz_hub_managers", 0),
+            "shipped_delta": shipped,
+            "added_delta": added,
+            "flags": flags,
+        }
+
+
+class ManagedHost:
+    """One manager under fleet watch: its observe-mode control loop
+    plus the shard weight (devices its engine mesh spans)."""
+
+    def __init__(self, name: str, source, shards: int = 1,
+                 interval: float = 5.0, now=None):
+        self.name = name
+        self.source = source
+        self.shards = max(1, int(shards))
+        self.pilot = Autopilot(source, ReportExecutor(),
+                               interval=interval, now=now)
+        self.last_sample: "dict | None" = None
+
+    def tick(self) -> dict:
+        """One per-host pass; an unreachable endpoint becomes a
+        HOST_DOWN report instead of an exception."""
+        try:
+            sample = self.pilot.source.sample()
+        except Exception as e:
+            return {"host": self.name, "reachable": False,
+                    "state": HOST_DOWN, "error": str(e)}
+        self.last_sample = sample
+        # feed the already-fetched sample through the pilot (one scrape
+        # per tick, not two)
+        orig = self.pilot.source
+        try:
+            self.pilot.source = _Stub(sample)
+            report = self.pilot.tick()
+        finally:
+            self.pilot.source = orig
+        worst = self.pilot.health.worst()
+        return {"host": self.name, "reachable": True,
+                "state": worst.name, "shards": self.shards,
+                "vm_live": sample.get("syz_vm_pool_live"),
+                "vm_target": sample.get("syz_vm_pool_target"),
+                "exec_rate": sample.get("syz_exec_rate", 0.0),
+                "report": report}
+
+
+class _Stub:
+    def __init__(self, sample):
+        self._s = sample
+
+    def sample(self):
+        return self._s
+
+
+class FleetAutopilot:
+    """The one-controller-over-N composition.  `managers` is a list of
+    (name, MetricsSource-like, shards) triples ((name, source) pairs
+    default to shards=1); `hub` an optional HubWatch."""
+
+    # a host's VMs-per-shard deviating this far from the fleet mean
+    # earns a rebalance recommendation
+    REBALANCE_RATIO = 2.0
+
+    def __init__(self, managers, hub: "HubWatch | None" = None,
+                 interval: float = 5.0, now=None):
+        self.hosts: "list[ManagedHost]" = []
+        for entry in managers:
+            name, source, *rest = entry
+            shards = rest[0] if rest else 1
+            self.hosts.append(ManagedHost(name, source, shards=shards,
+                                          interval=interval, now=now))
+        self.hub = hub
+        self.interval = float(interval)
+        self.stat_ticks = 0
+        self._last: "dict | None" = None
+
+    # -- one fleet pass -----------------------------------------------------
+
+    def tick(self) -> dict:
+        self.stat_ticks += 1
+        per_host = [h.tick() for h in self.hosts]
+        report = {
+            "ts": time.time(),
+            "hosts": per_host,
+            "worst": self._worst(per_host),
+            "pool": self._pool_decision(per_host),
+            "rotation": self._rotation_decision(per_host),
+        }
+        if self.hub is not None:
+            try:
+                report["hub"] = self.hub.check()
+            except Exception as e:
+                report["hub"] = {"error": str(e),
+                                 "flags": [{"issue": HOST_DOWN}]}
+        self._last = report
+        return report
+
+    @staticmethod
+    def _worst(per_host) -> str:
+        worst = State.HEALTHY.name
+        rank = {s.name: int(s) for s in State}
+        rank[HOST_DOWN] = max(rank.values()) + 1
+        for h in per_host:
+            if rank.get(h["state"], 0) > rank.get(worst, 0):
+                worst = h["state"]
+        return worst
+
+    def _pool_decision(self, per_host) -> dict:
+        """Shard-aware capacity view: total VMs vs total shards, plus
+        per-host rebalance recommendations when a reachable host's
+        VMs-per-shard falls outside REBALANCE_RATIO of the fleet
+        mean."""
+        live = {h["host"]: (h.get("vm_live") or 0.0)
+                for h in per_host if h.get("reachable")}
+        shards = {h["host"]: h.get("shards", 1)
+                  for h in per_host if h.get("reachable")}
+        total_vms = sum(live.values())
+        total_shards = sum(shards.values()) or 1
+        mean = total_vms / total_shards
+        recs = []
+        for name, n in live.items():
+            per_shard = n / shards[name]
+            if mean > 0 and per_shard > mean * self.REBALANCE_RATIO:
+                recs.append({"host": name, "action": "shrink",
+                             "vms_per_shard": round(per_shard, 2)})
+            elif mean > 0 and per_shard < mean / self.REBALANCE_RATIO:
+                recs.append({"host": name, "action": "grow",
+                             "vms_per_shard": round(per_shard, 2)})
+        return {"total_vms": total_vms, "total_shards": total_shards,
+                "vms_per_shard": round(mean, 2), "rebalance": recs}
+
+    def _rotation_decision(self, per_host) -> "dict | None":
+        """At most one rotation recommendation per tick: the reachable
+        host with the lowest exec-rate-weighted productivity whose own
+        pilot already proposed a rotation.  Fleet-serialized so N hosts
+        don't all churn their campaign assignments in the same tick."""
+        candidates = []
+        for h in per_host:
+            if not h.get("reachable"):
+                continue
+            for a in h.get("report", {}).get("actions", []):
+                if a["action"] == "rotate":
+                    candidates.append((h.get("exec_rate") or 0.0, h, a))
+        if not candidates:
+            return None
+        _, host, action = min(candidates, key=lambda t: t[0])
+        return {"host": host["host"], "component": action["component"],
+                "target": action["target"], "reason": action["reason"]}
+
+    # -- /healthz-shaped probe ----------------------------------------------
+
+    def health_json(self) -> "tuple[int, dict]":
+        """(status, body) with the same contract as the manager's
+        /healthz: 200 while every host answers below DEGRADED and no
+        hub flag is raised."""
+        report = self._last or self.tick()
+        bad = report["worst"] in (State.DEGRADED.name,
+                                  State.RESTARTING.name, HOST_DOWN)
+        hub_flags = report.get("hub", {}).get("flags", [])
+        code = 503 if bad or hub_flags else 200
+        return code, {
+            "status": "ok" if code == 200 else "degraded",
+            "worst": report["worst"],
+            "hosts": {h["host"]: h["state"] for h in report["hosts"]},
+            "hub_flags": hub_flags,
+            "ticks": self.stat_ticks,
+        }

@@ -1,0 +1,119 @@
+"""Covered-block coverage sketch: the hub's frontier-aware exchange
+filter (ISSUE 14 hub v2).
+
+Managers publish which raw-PC blocks they have already covered; the hub
+then ships a pending program to a manager only when the program's
+touched blocks are NOT all inside that manager's covered set — i.e.
+only programs plausibly carrying new signal travel.
+
+Design note — why NOT a bloom filter (the obvious "sketch"): a bloom
+over the covered set has the WRONG one-sided error for this filter.  A
+bloom false positive means a genuinely NEW block tests as "covered", so
+the program carrying it is filtered — a false negative of the exchange,
+and the acceptance bar is FN = 0 (a program with new blocks must never
+be withheld).  An exact set has the right error in both directions, and
+its cost is small because the sync is DELTA-based: a manager's covered
+set is derived from its PcMap keys, which are append-only (first-seen
+insertion order, never evicted), so each Hub.Sync ships only the blocks
+discovered since the last sync — steady-state traffic is proportional
+to NEW coverage, not corpus size.  False positives (shipping a program
+whose blocks the manager covered since the last sketch) are bounded by
+sketch staleness, i.e. one sync interval of frontier growth, and decay
+to zero as the frontier saturates.
+
+Block identity must be RAW-PC based (`raw_pc >> BLOCK_SHIFT`): dense
+bitmap indices are per-manager PcMap first-seen order, meaningless
+across hosts.  64-byte blocks (shift 6) ≈ basic-block granularity —
+the filter's FN=0 guarantee is at BLOCK granularity (a program whose
+every touched block is covered can still carry a new PC inside a
+covered block; it is filtered, and that PC arrives via the block's
+discovering manager instead).
+
+Wire format: sorted uint64 block ids, little-endian packed, base64
+(the RPC plane's b64 convention) — `encode_blocks`/`decode_blocks`.
+"""
+
+from __future__ import annotations
+
+import base64
+import threading
+
+import numpy as np
+
+BLOCK_SHIFT = 6          # 64-byte raw-PC blocks
+
+
+def blocks_of(pcs, shift: int = BLOCK_SHIFT) -> np.ndarray:
+    """Sorted unique uint64 block ids for a raw-PC array."""
+    a = np.asarray(pcs, np.uint64).ravel()
+    if a.size == 0:
+        return np.zeros((0,), np.uint64)
+    return np.unique(a >> np.uint64(shift))
+
+
+def encode_blocks(blocks) -> str:
+    """Block array → wire string (LE uint64, base64)."""
+    a = np.asarray(blocks, np.uint64).ravel()
+    return base64.b64encode(a.astype("<u8").tobytes()).decode()
+
+
+def decode_blocks(s: str) -> np.ndarray:
+    """Wire string → uint64 block array (empty on empty/None)."""
+    if not s:
+        return np.zeros((0,), np.uint64)
+    return np.frombuffer(base64.b64decode(s), dtype="<u8").copy()
+
+
+class BlockSketch:
+    """One manager's covered-raw-block set with append-only delta
+    export (thread-safe).  `add_pcs` folds a cover in and returns the
+    blocks that were new — exactly the delta the next Hub.Sync ships,
+    so the wire cost tracks frontier growth."""
+
+    def __init__(self, shift: int = BLOCK_SHIFT):
+        self.shift = shift
+        self._covered: set[int] = set()
+        self._mu = threading.Lock()
+
+    def __len__(self) -> int:
+        with self._mu:
+            return len(self._covered)
+
+    def add_pcs(self, pcs) -> np.ndarray:
+        """Fold a raw-PC cover in; returns the NEWLY covered blocks
+        (sorted uint64 — the delta)."""
+        return self.add_blocks(blocks_of(pcs, self.shift))
+
+    def add_blocks(self, blocks) -> np.ndarray:
+        bs = np.asarray(blocks, np.uint64).ravel()
+        fresh = []
+        with self._mu:
+            for b in bs:
+                ib = int(b)
+                if ib not in self._covered:
+                    self._covered.add(ib)
+                    fresh.append(ib)
+        return np.array(sorted(fresh), np.uint64)
+
+    def covers(self, blocks) -> bool:
+        """True iff EVERY block is already covered — the ship/skip
+        verdict (skip only when nothing can be new)."""
+        bs = np.asarray(blocks, np.uint64).ravel()
+        with self._mu:
+            return all(int(b) in self._covered for b in bs)
+
+    def snapshot(self) -> np.ndarray:
+        """The full covered set (sorted uint64) — the `reset=True`
+        resync payload after a reconnect."""
+        with self._mu:
+            return np.array(sorted(self._covered), np.uint64)
+
+
+def should_ship(prog_blocks: np.ndarray, covered: "set[int]") -> bool:
+    """The hub-side filter verdict for one pending program: ship unless
+    the program's block set is KNOWN (non-empty) and fully covered.
+    Unknown block sets (legacy managers pushing bare programs) always
+    ship — the FN=0 guarantee never leans on optional metadata."""
+    if prog_blocks is None or len(prog_blocks) == 0:
+        return True
+    return any(int(b) not in covered for b in prog_blocks)

@@ -152,6 +152,16 @@ print("telemetry ok: %d series" % len(series))
         assert prc["prog_ring_reader_reread"] \
             and prc["prog_ring_torn_skipped"] == 1 \
             and prc["prog_ring_resynced"], prc
+        # mesh-plane fold-in: one of two hub-federated managers is
+        # SIGKILLed mid-sync; the survivor must keep admitting, the
+        # restart must reconverge to the full union corpus (a sketch
+        # false negative would leave a hole), and the sketch must have
+        # withheld real traffic (filtered > 0 = strictly-fewer-than-
+        # naive exchange)
+        hubc = out["hub"]
+        assert hubc["survivor_kept_fuzzing"] \
+            and hubc["exchange_false_negatives"] == 0 \
+            and hubc["hub_sketch_filtered"] > 0, hubc
         auto = out["autopilot"]
         assert auto["recovered"] and auto["frontier_bit_exact"] \
             and auto["corpus_lost"] == 0 \
@@ -159,7 +169,28 @@ print("telemetry ok: %d series" % len(series))
         print(f"[presubmit]   recovery {out['recovery_seconds']}s, "
               f"corpus {out['corpus_size']}, 0 lost; autopilot "
               f"detect {auto['autopilot_detect_seconds']}s / recover "
-              f"{auto['autopilot_recover_seconds']}s")
+              f"{auto['autopilot_recover_seconds']}s; hub fleet "
+              f"reconverge {hubc['reconverge_seconds']}s")
+
+    def mesh_smoke():
+        # two-process pod-topology seam: loopback jax.distributed
+        # handshake (2 procs x 4 local = 8 global devices), process-
+        # local slice math, and sharded==serial bit-exactness at 0 warm
+        # recompiles in every process + the 8-device parent mesh
+        import json
+
+        r = subprocess.run(
+            [sys.executable, "tools/mesh_smoke.py", "--smoke"],
+            cwd=root, env=env, capture_output=True, text=True,
+            timeout=600)
+        if r.returncode != 0:
+            sys.stderr.write(r.stdout[-2000:] + r.stderr[-2000:])
+            raise SystemExit(f"mesh smoke failed ({r.returncode})")
+        out = json.loads(r.stdout.strip().splitlines()[-1])
+        assert out["ok"] and out["parent"]["bit_exact"], out
+        print(f"[presubmit]   2-process handshake ok, "
+              f"{out['parent']['devices']}-device parent mesh, "
+              f"{out['parent']['bits_lit']} bits bit-exact")
 
     def bench_smoke():
         # seconds-scale CPU-only bench pass on tiny shapes: catches
@@ -199,6 +230,17 @@ print("telemetry ok: %d series" % len(series))
             f"synth megakernel under 10x host generator: {sd} vs {sh}"
         assert out["extras"]["synth_recompiles_warm"] == 0, \
             "synth megakernel recompiled warm"
+        # mesh-plane acceptance: the sharded signal-diff path must
+        # stay recompile-free warm, and the hub exchange bench must
+        # prove 0 sketch false negatives while filtering > 0 programs
+        assert out["extras"]["sharded_recompiles_warm"] == 0, \
+            "sharded engine recompiled warm"
+        assert out["extras"]["signal_diff_prio_updates_per_sec_sharded"] > 0
+        assert out["extras"]["hub_sync_programs_per_sec"] > 0
+        assert out["extras"]["hub_sketch_fn"] == 0, \
+            "hub sketch produced exchange false negatives"
+        assert out["extras"]["hub_sketch_filtered"] > 0, \
+            "hub sketch never filtered (naive-equivalent exchange)"
 
     total = 0.0
     total += step("description tables", gen_tables)
@@ -207,6 +249,7 @@ print("telemetry ok: %d series" % len(series))
     total += step("engine + multichip smoke", engine_smoke)
     total += step("telemetry smoke", telemetry_smoke)
     total += step("chaos smoke (kill/restore cycle)", chaos_smoke)
+    total += step("mesh smoke (two-process pod seam)", mesh_smoke)
     total += step("bench smoke", bench_smoke)
     total += step("pytest", pytest_run)
     print(f"[presubmit] PASS in {total:.0f}s")

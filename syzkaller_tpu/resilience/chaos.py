@@ -691,6 +691,206 @@ def _admit_direct(mgr, inp, name: str = "serial") -> dict:
         "call_index": ci, "cover": cover})
 
 
+# -- hub-federated fleet chaos ------------------------------------------------
+
+
+def spawn_hub(workdir: str, port: int, key: str = "chaos",
+              log_path: "str | None" = None) -> subprocess.Popen:
+    """Start a hub subprocess on `workdir` serving RPC on `port`."""
+    os.makedirs(workdir, exist_ok=True)
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PYTHONPATH"] = repo_root() + (
+        os.pathsep + env["PYTHONPATH"] if env.get("PYTHONPATH") else "")
+    logf = open(log_path or os.path.join(workdir, "chaos-hub.log"), "ab")
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "syzkaller_tpu.hub",
+         "-addr", f"127.0.0.1:{port}", "-workdir", workdir,
+         "-key", key],
+        cwd=repo_root(), env=env, stdout=logf, stderr=subprocess.STDOUT,
+        start_new_session=True)
+    logf.close()
+    return proc
+
+
+def wait_hub(port: int, key: str = "chaos",
+             timeout: float = 60.0) -> float:
+    """Block until the hub answers Hub.Connect."""
+    from syzkaller_tpu import rpc
+
+    t0 = time.monotonic()
+    deadline = t0 + timeout
+    last = None
+    while time.monotonic() < deadline:
+        try:
+            cli = rpc.RpcClient(("127.0.0.1", port), timeout=5.0,
+                                retries=1)
+            cli.call("Hub.Connect", {"name": "probe", "key": key})
+            cli.close()
+            return time.monotonic() - t0
+        except Exception as e:
+            last = e
+            time.sleep(0.1)
+    raise TimeoutError(f"hub rpc :{port} never came up: {last}")
+
+
+def _corpus_sigs(workdir: str) -> "set[str]":
+    d = os.path.join(workdir, "corpus")
+    if not os.path.isdir(d):
+        return set()
+    return {n for n in os.listdir(d) if not n.startswith(".")}
+
+
+def run_hub_chaos(base_dir: str, n_inputs: int = 32,
+                  deadline_s: float = 120.0,
+                  verbose: bool = False) -> dict:
+    """Federation-tier chaos: kill one of two hub-federated managers
+    mid-sync and prove the exchange is crash-only too.
+
+      hub + managers A,B (sketch exchange on, 0.5s sync cadence) →
+      disjoint halves stormed into each → corpora CONVERGE through the
+      hub → SIGKILL B mid-sync → A keeps fuzzing (new inputs admitted
+      and pushed) → restart B (crash-only restore + sketch resync) →
+      B RECONVERGES to the same global corpus.
+
+    Asserts: both managers end with the full union corpus (exchange
+    false negatives = 0 — a sketch FN would leave a hole here), the
+    survivor admitted new work while its peer was dead, and the sketch
+    actually withheld traffic (each manager's own pushes are provably
+    covered, so filtered > 0 < naive ship-everything).  Returns the
+    measurements dict."""
+    from syzkaller_tpu.sys.table import load_table
+
+    def say(msg):
+        if verbose:
+            sys.stderr.write(f"[chaos:hub] {msg}\n")
+            sys.stderr.flush()
+
+    table = load_table(files=["probe.txt"])
+    inputs = synth_inputs(table, n_inputs + 8, seed=21)
+    half = n_inputs // 2
+    part_a, part_b, tail = (inputs[:half], inputs[half:n_inputs],
+                            inputs[n_inputs:])
+    all_progs = {inp[0]: inp for inp in inputs}
+    union_sigs = {hashlib.sha1(d).hexdigest() for d in all_progs}
+
+    hub_dir = os.path.join(base_dir, "hub")
+    hub_port = free_port()
+    say("spawning hub + 2 managers")
+    t0 = time.monotonic()
+    hub_proc = spawn_hub(hub_dir, hub_port)
+    out: dict = {}
+    procs: dict = {}
+    try:
+        wait_hub(hub_port)
+        ports = {"A": free_port(), "B": free_port()}
+        dirs = {n: os.path.join(base_dir, f"w-{n}") for n in ports}
+        for n in ports:
+            procs[n] = spawn_manager(
+                dirs[n], ports[n], name=f"chaos-{n}",
+                hub_addr=f"127.0.0.1:{hub_port}", hub_key="chaos",
+                hub_sync_interval=0.5)
+        drivers = {}
+        for n in ports:
+            wait_rpc(ports[n])
+            drivers[n] = FleetDriver(("127.0.0.1", ports[n]),
+                                     name=f"fuzz-{n}")
+            drivers[n].connect()
+            # every driver can replay ANY program: shared cover map
+            drivers[n].cover_of = {d: inp[3]
+                                   for d, inp in all_progs.items()}
+            drivers[n].sent = dict(all_progs)
+
+        say(f"storming disjoint halves ({half} each)")
+        assert drivers["A"].storm(part_a) == len(part_a)
+        assert drivers["B"].storm(part_b) == len(part_b)
+
+        def converge(names, want: "set[str]", label: str) -> float:
+            """Drive candidate pull+replay until every named manager's
+            persistent corpus holds `want`."""
+            t = time.monotonic()
+            deadline = t + deadline_s
+            while time.monotonic() < deadline:
+                done = True
+                for n in names:
+                    if want <= _corpus_sigs(dirs[n]):
+                        continue
+                    done = False
+                    drivers[n].poll()
+                    # replay BEFORE clearing: the manager dispenses
+                    # each candidate once (some arrive on the Connect
+                    # response), so a wiped candidate is lost forever
+                    drivers[n].replay_candidates()
+                    drivers[n].candidates = []
+                if done:
+                    return time.monotonic() - t
+                time.sleep(0.25)
+            missing = {n: len(want - _corpus_sigs(dirs[n]))
+                       for n in names}
+            raise TimeoutError(f"{label}: corpora never converged "
+                               f"(missing {missing})")
+
+        first_union = {hashlib.sha1(inp[0]).hexdigest()
+                       for inp in part_a + part_b}
+        out["converge_seconds"] = round(
+            converge(("A", "B"), first_union, "initial"), 3)
+        say(f"converged in {out['converge_seconds']}s; killing B")
+
+        sigkill(procs["B"])
+        # survivor keeps fuzzing: new work admitted + published while
+        # the peer is down
+        assert drivers["A"].storm(tail) == len(tail)
+        out["survivor_kept_fuzzing"] = True
+        time.sleep(1.0)          # a sync interval passes peerless
+
+        say("restarting B (crash-only restore + sketch resync)")
+        t_restart = time.monotonic()
+        procs["B"] = spawn_manager(
+            dirs["B"], ports["B"], name="chaos-B",
+            hub_addr=f"127.0.0.1:{hub_port}", hub_key="chaos",
+            hub_sync_interval=0.5)
+        wait_rpc(ports["B"])
+        drivers["B"] = FleetDriver(("127.0.0.1", ports["B"]),
+                                   name="fuzz-B")
+        drivers["B"].connect()
+        drivers["B"].cover_of = {d: inp[3]
+                                 for d, inp in all_progs.items()}
+        drivers["B"].sent = dict(all_progs)
+        out["reconverge_seconds"] = round(
+            converge(("A", "B"), union_sigs, "reconverge"), 3)
+        out["recovery_seconds"] = round(time.monotonic() - t_restart, 3)
+
+        # global frontier equivalence at corpus granularity: both
+        # managers hold exactly the union (no sketch false negative
+        # ever withheld a program a manager lacked)
+        sigs = {n: _corpus_sigs(dirs[n]) for n in dirs}
+        out["corpus_size"] = len(union_sigs)
+        out["exchange_false_negatives"] = max(
+            len(union_sigs - sigs[n]) for n in sigs)
+        assert out["exchange_false_negatives"] == 0, \
+            f"exchange FN: {out}"
+
+        # the sketch withheld real traffic: read the hub's persisted
+        # per-manager meta restart-style (each manager's own pushes are
+        # covered by its own sketch, so filtered must be > 0; a naive
+        # exchange would have shipped every one of them back)
+        sigkill(hub_proc)
+        from syzkaller_tpu.hub.state import HubState
+        st = HubState(hub_dir)      # restart-parity read of hub state
+        filtered = sum(m.filtered for m in st.managers.values())
+        out["hub_sketch_filtered"] = filtered
+        out["hub_corpus"] = len(st.seq)
+        assert filtered > 0, "sketch never withheld a program " \
+            "(naive-equivalent exchange)"
+        out["hub_chaos_seconds"] = round(time.monotonic() - t0, 3)
+        say(f"ok: {out}")
+        return out
+    finally:
+        for p in list(procs.values()) + [hub_proc]:
+            if p.poll() is None:
+                sigkill(p)
+
+
 # -- the autopilot compound-failure cycle -------------------------------------
 
 

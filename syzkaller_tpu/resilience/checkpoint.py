@@ -154,6 +154,18 @@ def collect_snapshot(manager) -> bytes:
         ids, data = fronts[tag]
         arrays[f"frontier{i}_ids"] = ids
         arrays[f"frontier{i}_data"] = data
+    # shard layout stamp: snapshots carry host-canonical arrays, so a
+    # restore into ANY mesh shape is correct — the stamp exists so the
+    # restoring manager can LOG a layout change (a 4-device snapshot
+    # landing on an 8-device mesh re-sharding on ingest), not gate it
+    mesh = getattr(mgr.engine, "mesh", None)
+    shard_layout = {"devices": 1, "axes": []}
+    if mesh is not None:
+        shard_layout = {
+            "devices": int(np.prod(mesh.devices.shape)),
+            "axes": [[str(n), int(s)] for n, s in
+                     zip(mesh.axis_names, mesh.devices.shape)],
+        }
     meta = {
         "created_at": time.time(),
         "name": mgr.cfg.name,
@@ -164,6 +176,7 @@ def collect_snapshot(manager) -> bytes:
         "triage": [[cid, title, count]
                    for cid, title, count in tri_entries],
         "frontier_tags": ftags,
+        "shard_layout": shard_layout,
     }
     return encode_snapshot(meta, arrays)
 
@@ -196,6 +209,10 @@ class RestoredState:
         self.frontiers = {
             tag: (arrays[f"frontier{i}_ids"], arrays[f"frontier{i}_data"])
             for i, tag in enumerate(meta.get("frontier_tags", []))}
+        # layout the snapshotting engine ran under (informational; the
+        # arrays are host-canonical and restore into any mesh shape)
+        self.shard_layout = meta.get("shard_layout") or {"devices": 1,
+                                                         "axes": []}
         self.path = ""
         self.corrupt_skipped = 0
 

@@ -107,6 +107,17 @@ class SignatureKernel:
         self._mu = threading.Lock()
         self._cluster_fn = None      # built lazily on first device use
         self._ts_dummy = None
+        self._mesh = None            # optional report-batch sharding
+
+    def shard(self, mesh) -> None:
+        """Shard the similarity dispatch's report batch over the
+        engine's PC-axis mesh: the padded (B, D) feature matrix is
+        placed row-sharded (B is always a pow2 bucket, so it divides
+        the mesh evenly whenever B >= mesh size) and GSPMD partitions
+        the blocked matmul.  Labels are unchanged — the min-label
+        fixpoint is order-free — so sharded and serial clustering are
+        bit-exact."""
+        self._mesh = mesh
 
     # -- featurization (host) ---------------------------------------------
 
@@ -192,6 +203,13 @@ class SignatureKernel:
         B = pow2_bucket(n, self.min_batch, self.max_batch)
         padded = np.zeros((B, self.D), np.float32)
         padded[:n] = feats
+        if self._mesh is not None \
+                and B % self._mesh.devices.size == 0:
+            import jax
+            from jax.sharding import NamedSharding, PartitionSpec
+            padded = jax.device_put(
+                padded, NamedSharding(self._mesh,
+                                      PartitionSpec("pc", None)))
         with self._mu:
             if self._cluster_fn is None:
                 self._build()

@@ -436,11 +436,19 @@ def test_healthz_endpoint_hub():
     from types import SimpleNamespace
 
     from syzkaller_tpu.hub import http as hub_http
+    from syzkaller_tpu.hub.hub import Hub
     from syzkaller_tpu.telemetry import Registry
 
+    # a fake hub carrying the real health() contract over fake state —
+    # /healthz now delegates to Hub.health (stale-sync detection lives
+    # there; the threshold path has its own test in test_mesh.py)
     hub = SimpleNamespace(
-        state=SimpleNamespace(seq=[], managers={}),
-        registry=Registry())
+        state=SimpleNamespace(seq=[], managers={},
+                              sync_age=lambda name: 0.0,
+                              global_frontier=lambda: set()),
+        registry=Registry(),
+        sync_age_threshold=300.0)
+    hub.health = lambda: Hub.health(hub)
     srv = hub_http.serve(hub, "127.0.0.1", 0)
     host, port = srv.server_address
     try:

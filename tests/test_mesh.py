@@ -1,0 +1,426 @@
+"""Pod-scale mesh plane: the covered-block sketch's one-sided error
+(exchange FN = 0, FP bounded by the un-synced delta), frontier-aware
+hub filtering, two-manager federation converging bit-exactly to a
+single merged-corpus run, the multi-process topology math behind the
+`mesh_hosts`/`mesh_devices_per_host` knobs, hub sync-age health, the
+fleet autopilot's cross-host decisions, sharded triage equality, and
+the snapshot shard-layout stamp."""
+
+import time
+
+import numpy as np
+import pytest
+
+from syzkaller_tpu.manager.config import Config, ConfigError, loads
+from syzkaller_tpu.manager.manager import Manager
+from syzkaller_tpu.mesh.dist import local_mesh_size
+from syzkaller_tpu.mesh.fleet import (
+    HOST_DOWN, SHIP_STALLED, SYNC_STALLED, FleetAutopilot, HubWatch)
+from syzkaller_tpu.mesh.sketch import (
+    BLOCK_SHIFT, blocks_of, decode_blocks, encode_blocks, should_ship)
+from syzkaller_tpu.resilience import chaos
+from syzkaller_tpu.sys.table import load_table
+
+
+@pytest.fixture(scope="module")
+def table():
+    return load_table(files=["probe.txt"])
+
+
+def _mk_manager(tmp_path, table, name, **over):
+    cfg = dict(chaos.manager_config(str(tmp_path / name), 0),
+               name=name, snapshot_interval=0.0)
+    cfg.update(over)
+    return Manager(Config(**cfg), table=table)
+
+
+def _stop(*mgrs):
+    for m in mgrs:
+        m.server.close()
+        m.dstream.stop()
+        if m.coalescer is not None:
+            m.coalescer.stop()
+
+
+# -- covered-block sketch ----------------------------------------------------
+
+
+def test_blocks_wire_roundtrip():
+    pcs = np.array([0x40, 0x41, 0x80, 0xFFFF_FFFF_0000], np.uint64)
+    b = blocks_of(pcs)
+    # 0x40 and 0x41 share a 64-byte block; 0x80 and the high PC don't
+    assert set(b.tolist()) == {1, 2, 0xFFFF_FFFF_0000 >> BLOCK_SHIFT}
+    wire = encode_blocks(b)
+    back = decode_blocks(wire)
+    assert np.array_equal(np.sort(b), np.sort(back))
+    assert len(decode_blocks("")) == 0
+    # unknown block sets always ship (one-sided error by construction)
+    assert should_ship(None, {1, 2})
+    assert should_ship(np.array([], np.uint64), {1, 2})
+    assert should_ship(np.array([1, 3], np.uint64), {1, 2})
+    assert not should_ship(np.array([1, 2], np.uint64), {1, 2})
+
+
+def test_sketch_fn_zero_fp_bounded():
+    """10k-program seeded corpus: the ship/withhold decision against a
+    STALE covered set (one un-synced delta behind truth) must never
+    withhold a program carrying an uncovered block (FN = 0), and every
+    false ship must be attributable to the delta (FP bound)."""
+    rng = np.random.default_rng(7)
+    progs = [np.unique(rng.integers(0, 1 << 18, size=24).astype(
+        np.uint64)) << np.uint64(BLOCK_SHIFT + 2)
+        for _ in range(10_000)]
+    blocks = [blocks_of(p) for p in progs]
+
+    true_cov: "set[int]" = set()         # manager's real frontier
+    for b in blocks[:6000]:
+        true_cov.update(int(x) for x in b)
+    stale_cov: "set[int]" = set()        # what the hub has (lags one
+    for b in blocks[:5000]:              # sync interval behind)
+        stale_cov.update(int(x) for x in b)
+    delta = true_cov - stale_cov
+
+    fn = fp = fp_bound = shipped = 0
+    for b in blocks:
+        ship = should_ship(b, stale_cov)
+        new_stale = any(int(x) not in stale_cov for x in b)
+        new_true = any(int(x) not in true_cov for x in b)
+        if new_stale and not ship:
+            fn += 1
+        shipped += ship
+        if ship and not new_true:
+            fp += 1
+        if not new_true and any(int(x) in delta for x in b):
+            fp_bound += 1
+    assert fn == 0
+    # exact one-sided characterization: a false ship exists iff the
+    # program's only "new" blocks sit inside the un-synced delta
+    assert fp == fp_bound
+    assert 0 < shipped < len(progs)
+    # once the delta syncs, the false ships vanish entirely
+    fp_synced = sum(1 for b in blocks
+                    if should_ship(b, true_cov)
+                    and not any(int(x) not in true_cov for x in b))
+    assert fp_synced == 0
+
+
+# -- hub frontier filtering --------------------------------------------------
+
+
+def test_hub_state_sketch_filtering(tmp_path):
+    from syzkaller_tpu.hub.state import HubState
+
+    st = HubState(str(tmp_path / "hub"))
+    progs = [b"prog-%d" % i for i in range(4)]
+    blocks = [np.array([i * 2, i * 2 + 1], np.uint64) for i in range(4)]
+    st.add("a", progs, blocks)
+    # b covers the blocks of progs 0 and 2 -> exactly those withheld
+    st.observe_sketch("b", np.array([0, 1, 4, 5], np.uint64))
+    out, more, filtered = st.pending("b")
+    assert out == [progs[1], progs[3]]
+    assert more == 0 and filtered == 2
+    # the cursor advanced PAST the filtered entries permanently
+    out2, _, f2 = st.pending("b")
+    assert out2 == [] and f2 == 0
+    # a manager with no sketch gets naive ship-everything
+    out3, _, f3 = st.pending("naive")
+    assert out3 == progs and f3 == 0
+    # the global frontier is the union of every manager's sketch
+    st.observe_sketch("a", np.array([9], np.uint64))
+    assert st.global_frontier() == {0, 1, 4, 5, 9}
+    # sketch persistence: a reloaded hub still filters
+    st.flush_writes(st.take_writes())
+    st2 = HubState(str(tmp_path / "hub"))
+    assert st2.managers["b"].covered == {0, 1, 4, 5}
+    assert st2.managers["b"].filtered == 2
+
+
+def test_hub_healthz_stale_sync(tmp_path):
+    from syzkaller_tpu.hub.hub import Hub
+
+    hub = Hub(str(tmp_path / "hub"), sync_age_threshold=5.0)
+    try:
+        code, body = hub.health()
+        assert code == 200 and body["status"] == "ok"
+        hub.state.add("m1", [b"p"])
+        hub.state.pending("m1")          # stamps last_sync
+        code, body = hub.health()
+        assert code == 200
+        # age the sync past the threshold -> 503 names the manager
+        hub.state.managers["m1"].last_sync = time.time() - 60.0
+        code, body = hub.health()
+        assert code == 503 and body["status"] == "stale_sync"
+        assert "m1" in body["stale"]
+        # threshold 0 disables the check
+        hub.sync_age_threshold = 0.0
+        assert hub.health()[0] == 200
+    finally:
+        hub.close()
+
+
+def test_hub_per_manager_metrics(tmp_path):
+    from syzkaller_tpu.hub.hub import Hub
+    from syzkaller_tpu.telemetry import expo
+
+    hub = Hub(str(tmp_path / "hub"))
+    try:
+        hub.rpc_connect({"name": "m1"})
+        hub.rpc_sync({"name": "m1", "add": [],
+                      "sketch": encode_blocks(
+                          np.array([1, 2, 3], np.uint64)),
+                      "sketch_reset": True})
+        series = expo.parse_prometheus_text(
+            expo.prometheus_text([hub.registry]))
+        assert series['syz_hub_manager_corpus{manager="m1"}'] == 0
+        assert series['syz_hub_manager_covered_blocks{manager="m1"}'] == 3
+        assert series['syz_hub_sync_age_seconds{manager="m1"}'] < 5.0
+        assert series["syz_hub_frontier_blocks"] == 3
+    finally:
+        hub.close()
+
+
+# -- two-manager federation == one merged run --------------------------------
+
+
+def test_two_manager_sync_equals_merged_run(tmp_path, table):
+    """Two hub-federated managers admitting DISJOINT halves converge,
+    through sync alone, to the same corpus a single manager gets from
+    admitting the merged set — and manager A's frontier is bit-exact
+    against a serial replay in A's admission order."""
+    import hashlib
+
+    from syzkaller_tpu.hub.hub import Hub
+
+    inputs = chaos.synth_inputs(table, 8, seed=3)
+    by_data = {inp[0]: inp for inp in inputs}
+    hub = Hub(str(tmp_path / "hub"), key="k")
+    hub.serve_background()
+    mgr_a = _mk_manager(tmp_path, table, "fedA",
+                        hub_addr=hub.addr, hub_key="k")
+    mgr_b = _mk_manager(tmp_path, table, "fedB",
+                        hub_addr=hub.addr, hub_key="k")
+    try:
+        for inp in inputs[:4]:
+            chaos._admit_direct(mgr_a, inp, name="vmA")
+        for inp in inputs[4:]:
+            chaos._admit_direct(mgr_b, inp, name="vmB")
+        # sync until converged: push/pull, then replay pulled
+        # candidates the way a real fuzzer does (re-run + report cover)
+        for _ in range(6):
+            mgr_a.hub_sync_once()
+            mgr_b.hub_sync_once()
+            for mgr, vm in ((mgr_a, "vmA"), (mgr_b, "vmB")):
+                for data in list(mgr.candidates):
+                    chaos._admit_direct(mgr, by_data[data], name=vm)
+            if len(mgr_a.corpus) == 8 and len(mgr_b.corpus) == 8:
+                break
+        assert len(mgr_a.corpus) == 8 and len(mgr_b.corpus) == 8
+        sigs = lambda m: {hashlib.sha1(it.data).hexdigest()
+                          for it in m.corpus.values()}
+        assert sigs(mgr_a) == sigs(mgr_b)
+
+        # each manager's own pushes are covered by its own sketch, so
+        # the hub withheld them from their pusher (self-repull noise
+        # is gone as a filtering side effect)
+        assert sum(m.filtered for m in
+                   hub.state.managers.values()) > 0
+
+        # bit-exactness: a serial manager admitting A's corpus in A's
+        # admission order, over A's PcMap key order, must land on the
+        # identical frontier bitmaps
+        mgr_s = _mk_manager(tmp_path, table, "serial")
+        try:
+            mgr_s.pcmap.preseed(mgr_a.pcmap.export_keys())
+            for it in mgr_a.corpus.values():
+                chaos._admit_direct(mgr_s, by_data[it.data], name="vmS")
+            for key in ("corpus_cover", "max_cover"):
+                a = np.asarray(getattr(mgr_a.engine, key))
+                s = np.asarray(getattr(mgr_s.engine, key))
+                assert (a == s).all(), f"{key} diverged"
+        finally:
+            _stop(mgr_s)
+    finally:
+        _stop(mgr_a, mgr_b)
+        hub.close()
+
+
+# -- multi-process topology math --------------------------------------------
+
+
+def test_mesh_pod_config_knobs():
+    with pytest.raises(ConfigError):
+        loads('{"mesh_hosts": 0}')
+    with pytest.raises(ConfigError):
+        loads('{"mesh_devices_per_host": -1}')
+    # pod knobs without a mesh are meaningless
+    with pytest.raises(ConfigError):
+        loads('{"mesh_hosts": 2}')
+    with pytest.raises(ConfigError):
+        loads('{"mesh": 8, "mesh_hosts": 2, "mesh_devices_per_host": 3}')
+    with pytest.raises(ConfigError):
+        loads('{"mesh": 8, "mesh_hosts": 3}')
+    cfg = loads('{"mesh": 8, "mesh_hosts": 2, '
+                '"mesh_devices_per_host": 4}')
+    assert local_mesh_size(cfg) == 4
+    # devices_per_host derives from mesh / hosts when omitted
+    cfg2 = loads('{"mesh": 8, "mesh_hosts": 4}')
+    assert local_mesh_size(cfg2) == 2
+    # single-process: the whole mesh is local
+    assert local_mesh_size(loads('{"mesh": 4}')) == 4
+    # ConfigError stays a ValueError (existing raises-tests contract)
+    assert issubclass(ConfigError, ValueError)
+
+
+def test_pc_mesh_oversize_is_config_error():
+    from syzkaller_tpu.cover.engine import pc_mesh
+
+    with pytest.raises(ConfigError):
+        pc_mesh(4096, platform="cpu")
+
+
+# -- fleet autopilot ---------------------------------------------------------
+
+
+class _Src:
+    def __init__(self, sample):
+        self.sample_dict = dict(sample)
+
+    def sample(self):
+        return dict(self.sample_dict)
+
+
+class _DeadSrc:
+    def sample(self):
+        raise ConnectionError("no route to host")
+
+
+_HEALTHY = {"syz_exec_rate": 50.0, "syz_vm_pool_live": 4.0,
+            "syz_vm_pool_target": 4.0}
+
+
+def test_fleet_host_down_is_health_not_exception():
+    fleet = FleetAutopilot([("a", _Src(_HEALTHY)), ("b", _DeadSrc())],
+                           now=lambda: 0.0)
+    rep = fleet.tick()
+    states = {h["host"]: h["state"] for h in rep["hosts"]}
+    assert states["b"] == HOST_DOWN
+    assert rep["worst"] == HOST_DOWN
+    code, body = fleet.health_json()
+    assert code == 503 and body["hosts"]["b"] == HOST_DOWN
+    # all healthy -> 200
+    fleet2 = FleetAutopilot([("a", _Src(_HEALTHY))], now=lambda: 0.0)
+    fleet2.tick()
+    assert fleet2.health_json()[0] == 200
+
+
+def test_fleet_shard_aware_rebalance():
+    a = dict(_HEALTHY, syz_vm_pool_live=16.0)
+    b = dict(_HEALTHY, syz_vm_pool_live=2.0)
+    fleet = FleetAutopilot([("a", _Src(a), 1), ("b", _Src(b), 4)],
+                           now=lambda: 0.0)
+    pool = fleet.tick()["pool"]
+    assert pool["total_vms"] == 18.0 and pool["total_shards"] == 5
+    recs = {r["host"]: r["action"] for r in pool["rebalance"]}
+    # 16 VMs/shard vs a 3.6 fleet mean -> shrink; 0.5 -> grow
+    assert recs == {"a": "shrink", "b": "grow"}
+
+
+def test_fleet_single_rotation_per_tick():
+    """Both hosts' pilots propose a rotation; the fleet recommends
+    exactly ONE, aimed at the lower-exec-rate host."""
+    wedged = {
+        "syz_exec_rate": 50.0,
+        'syz_new_cov_per_1k_exec{campaign="all"}': 2.0,
+        'syz_new_cov_per_1k_exec{campaign="wedged"}': 0.0,
+        'syz_new_cov_per_1k_exec{campaign="hot"}': 9.0,
+        'syz_campaign_cluster_rate{campaign="wedged"}': 0.0,
+        'syz_campaign_cluster_rate{campaign="hot"}': 0.02,
+        'syz_campaign_assigned{campaign="wedged"}': 1.0,
+        'syz_campaign_assigned{campaign="hot"}': 1.0,
+    }
+    slow = dict(wedged, syz_exec_rate=5.0)
+    fleet = FleetAutopilot([("fast", _Src(wedged)), ("slow", _Src(slow))],
+                           now=lambda: 0.0)
+    rot = None
+    for _ in range(6):                   # hysteresis: DEGRADED takes ticks
+        rot = fleet.tick()["rotation"]
+        if rot:
+            break
+    assert rot is not None
+    assert rot["host"] == "slow"
+    assert rot["component"] == "wedged" and rot["target"] == "hot"
+
+
+def test_hub_watch_flags():
+    stale = {
+        'syz_hub_sync_age_seconds{manager="m1"}': 900.0,
+        'syz_hub_sync_age_seconds{manager="m2"}': 3.0,
+        "syz_hub_corpus_size": 10.0, "syz_hub_managers": 2.0,
+        "syz_hub_progs_added_total": 5.0,
+        "syz_hub_progs_shipped_total": 7.0,
+    }
+    w = HubWatch(_Src(stale), sync_age_threshold=300.0)
+    flags = w.check()["flags"]
+    assert [f["issue"] for f in flags] == [SYNC_STALLED]
+    assert 'm1' in flags[0]["series"]
+    # ship stall: adds flow between ticks but nothing ships with >= 2
+    # managers attached
+    src = _Src(dict(stale, **{
+        'syz_hub_sync_age_seconds{manager="m1"}': 1.0,
+        "syz_hub_progs_added_total": 25.0}))
+    w2 = HubWatch(_Src(dict(stale, **{
+        'syz_hub_sync_age_seconds{manager="m1"}': 1.0})),
+        sync_age_threshold=300.0)
+    w2.check()
+    w2.source = src
+    flags2 = w2.check()["flags"]
+    assert [f["issue"] for f in flags2] == [SHIP_STALLED]
+
+
+# -- sharded triage ----------------------------------------------------------
+
+
+def test_sharded_triage_bit_exact():
+    from syzkaller_tpu.cover.engine import pc_mesh
+    from syzkaller_tpu.triage.signature import SignatureKernel
+
+    rng = np.random.default_rng(5)
+    reports = []
+    for i in range(64):
+        fam = i % 7
+        frames = [f"func_{fam}_{j}" for j in range(4)]
+        reports.append((f"KASAN: use-after-free in func_{fam}_0",
+                        frames))
+    serial = SignatureKernel()
+    sharded = SignatureKernel()
+    sharded.shard(pc_mesh(2, "cpu"))
+    feats = serial.featurize(reports)
+    a = serial.cluster(feats)
+    b = sharded.cluster(sharded.featurize(reports))
+    assert np.array_equal(a, b)
+
+
+# -- snapshot shard-layout stamp ---------------------------------------------
+
+
+def test_snapshot_shard_layout_stamp(tmp_path, table):
+    from syzkaller_tpu.resilience.checkpoint import (
+        RestoredState, collect_snapshot, decode_snapshot)
+
+    mgr = _mk_manager(tmp_path, table, "layout",
+                      mesh=2, mesh_platform="cpu")
+    try:
+        inp = chaos.synth_inputs(table, 1, seed=9)[0]
+        chaos._admit_direct(mgr, inp)
+        rs = RestoredState(*decode_snapshot(collect_snapshot(mgr)))
+        assert rs.shard_layout["devices"] == 2
+        assert rs.shard_layout["axes"] == [["pc", 2]]
+    finally:
+        _stop(mgr)
+    # unmeshed managers stamp the 1-device layout
+    mgr1 = _mk_manager(tmp_path, table, "layout1")
+    try:
+        rs1 = RestoredState(*decode_snapshot(collect_snapshot(mgr1)))
+        assert rs1.shard_layout == {"devices": 1, "axes": []}
+    finally:
+        _stop(mgr1)

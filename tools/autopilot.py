@@ -8,6 +8,19 @@ endpoint — observe mode.
     python tools/autopilot.py --metrics ... --once
     python tools/autopilot.py --healthz http://host:port/healthz
 
+Fleet mode — ONE controller over N managers plus the hub
+(syzkaller_tpu/mesh/fleet.py): per-host health roll-up, shard-aware
+pool rebalance recommendations, fleet-serialized rotation, and the
+hub-exchange watchdog, one JSON line per tick:
+
+    python tools/autopilot.py \
+        --fleet a=http://h1:7700/metrics \
+        --fleet b=http://h2:7700/metrics:8 \
+        --hub http://hub:7789/metrics --once
+
+(the optional `:N` suffix is the host's shard weight — how many mesh
+devices its engine spans; defaults to 1)
+
 Each tick scrapes /metrics, runs the health state machines + policy,
 and prints ONE JSON line: per-component health states and the actions
 the in-process autopilot would fire (outcome "observe_only" — a remote
@@ -48,6 +61,38 @@ def probe_healthz(url: str) -> int:
     return 0 if code == 200 else 1
 
 
+def run_fleet(args) -> int:
+    from syzkaller_tpu.autopilot import HttpSource
+    from syzkaller_tpu.mesh.fleet import FleetAutopilot, HubWatch
+
+    managers = []
+    for spec in args.fleet:
+        name, _, url = spec.partition("=")
+        if not url:
+            print(f"bad --fleet spec {spec!r} (want NAME=URL[:SHARDS])",
+                  file=sys.stderr)
+            return 2
+        shards = 1
+        base, _, tail = url.rpartition(":")
+        if tail.isdigit() and "/" not in tail:
+            url, shards = base, int(tail)
+        managers.append((name, HttpSource(url), shards))
+    hub = HubWatch(HttpSource(args.hub),
+                   sync_age_threshold=args.sync_age) if args.hub else None
+    fleet = FleetAutopilot(managers, hub=hub, interval=args.interval)
+    n = 0
+    while True:
+        report = fleet.tick()
+        print(json.dumps(report, default=str), flush=True)
+        n += 1
+        if args.once or (args.ticks and n >= args.ticks):
+            break
+        time.sleep(args.interval)
+    if args.once:
+        return 0 if fleet.health_json()[0] == 200 else 1
+    return 0
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--metrics", help="manager /metrics URL to scrape")
@@ -58,12 +103,24 @@ def main(argv=None) -> int:
                     help="one tick, exit 0 iff nothing is DEGRADED")
     ap.add_argument("--ticks", type=int, default=0,
                     help="stop after N ticks (0 = run until ^C)")
+    ap.add_argument("--fleet", action="append", default=[],
+                    metavar="NAME=URL[:SHARDS]",
+                    help="fleet mode: a managed host's /metrics URL "
+                         "(repeat per host); optional :N shard weight")
+    ap.add_argument("--hub", default="",
+                    help="fleet mode: hub /metrics URL for the "
+                         "exchange watchdog")
+    ap.add_argument("--sync-age", type=float, default=300.0,
+                    help="fleet mode: flag managers whose hub sync "
+                         "age exceeds this (seconds)")
     args = ap.parse_args(argv)
 
     if args.healthz:
         return probe_healthz(args.healthz)
+    if args.fleet:
+        return run_fleet(args)
     if not args.metrics:
-        ap.error("--metrics or --healthz is required")
+        ap.error("--metrics, --fleet, or --healthz is required")
 
     from syzkaller_tpu.autopilot import (
         Autopilot, HttpSource, ReportExecutor, State)

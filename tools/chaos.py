@@ -64,6 +64,13 @@ def main(argv=None) -> int:
             # skipped, new generation flows)
             out["prog_ring"] = chaos.run_prog_ring_chaos(
                 os.path.join(base, "prog-ring"), verbose=verbose)
+            # mesh-plane fold-in: kill one of two hub-federated
+            # managers mid-sync; the survivor keeps fuzzing and the
+            # restarted manager reconverges to the same global corpus
+            # (exchange false negatives must be 0)
+            out["hub"] = chaos.run_hub_chaos(
+                os.path.join(base, "hub-fleet"), n_inputs=min(n, 32),
+                verbose=verbose)
         if not args.no_autopilot:
             # the compound-failure cycle: kill 2 of N VM threads + flap
             # the backend + wedge a campaign, autopilot remediates all

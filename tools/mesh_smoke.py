@@ -1,0 +1,187 @@
+#!/usr/bin/env python3
+"""Two-process mesh smoke: the pod-topology seam, driven on one box.
+
+Validates the three things CI CAN pin about the multi-host mesh plane
+without TPU hardware (jaxlib's CPU backend forms the global device
+view but rejects cross-process collectives — see mesh/dist.py):
+
+  1. distributed handshake — two processes jax.distributed.initialize
+     against a loopback coordinator and agree on the topology (process
+     count 2, global devices = sum of local slices);
+  2. process-local slicing — each process builds its engine mesh from
+     mesh/dist's `local_mesh_size` over ITS OWN devices only (the
+     `mesh_devices_per_host` contract);
+  3. sharded == serial, 0 warm recompiles — in every process, the same
+     update stream through a sharded and an unsharded engine exports
+     bit-identical state, and the warmed fused dispatch never compiles
+     again (CompileCounter window).
+
+    python tools/mesh_smoke.py --smoke      # CI entry (presubmit)
+    python tools/mesh_smoke.py              # same, verbose
+
+Exit 0 = all checks passed in both workers and the parent.
+"""
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+DEVS_PER_PROC = 4
+NPCS = 1 << 12
+NCALLS = 16
+
+
+def _force_cpu(ndev: int) -> None:
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    os.environ["XLA_FLAGS"] = (
+        os.environ.get("XLA_FLAGS", "")
+        + f" --xla_force_host_platform_device_count={ndev}").strip()
+
+
+def sharded_vs_serial(n_dev: int) -> dict:
+    """Same deterministic update stream through a serial and a sharded
+    engine; asserts exported state is bit-identical and the warmed
+    dispatch stays compile-free."""
+    import numpy as np
+
+    from syzkaller_tpu.cover import sets
+    from syzkaller_tpu.cover.engine import CoverageEngine, pc_mesh
+    from syzkaller_tpu.vet.runtime import CompileCounter
+
+    rng = np.random.default_rng(1234)
+    mesh = pc_mesh(n_dev, "cpu")
+    serial = CoverageEngine(npcs=NPCS, ncalls=NCALLS, corpus_cap=64)
+    sharded = CoverageEngine(npcs=NPCS, ncalls=NCALLS, corpus_cap=64,
+                             mesh=mesh)
+
+    def batch(k):
+        covers = [sets.canonicalize(
+            rng.integers(0, NPCS, size=48).astype(np.uint32))
+            for _ in range(8)]
+        calls = rng.integers(0, NCALLS, size=8).astype(np.int32)
+        idx = np.zeros((8, 128), np.int32)
+        valid = np.zeros((8, 128), bool)
+        for i, c in enumerate(covers):
+            idx[i, : len(c)] = c
+            valid[i, : len(c)] = True
+        return calls, idx, valid
+
+    streams = [batch(k) for k in range(6)]
+    # warm both engines on the first batch, then pin compiles
+    for eng in (serial, sharded):
+        calls, idx, valid = streams[0]
+        np.asarray(eng.update_batch(calls, idx, valid).has_new)
+    recompiles = {}
+    for name, eng in (("serial", serial), ("sharded", sharded)):
+        with CompileCounter() as cc:
+            for calls, idx, valid in streams[1:]:
+                np.asarray(eng.update_batch(calls, idx, valid).has_new)
+        recompiles[name] = cc.count
+        assert cc.count == 0, f"{name}: warm recompiles {cc.events}"
+    a, b = serial.export_state(), sharded.export_state()
+    for key in ("max_cover", "corpus_cover", "flakes"):
+        assert np.array_equal(np.asarray(a[key]), np.asarray(b[key])), \
+            f"state divergence in {key}"
+    lit = int(np.unpackbits(
+        np.asarray(a["max_cover"], np.uint32).view(np.uint8)).sum())
+    return {"devices": n_dev, "bits_lit": lit,
+            "warm_recompiles": recompiles, "bit_exact": True}
+
+
+def run_worker(args) -> int:
+    _force_cpu(DEVS_PER_PROC)
+    from syzkaller_tpu.mesh.dist import (
+        init_distributed, local_mesh_size, process_topology)
+
+    ok = init_distributed(coordinator=args.coordinator,
+                          num_processes=args.nprocs,
+                          process_id=args.worker)
+    topo = process_topology()
+    assert ok, "distributed init did not come up"
+    assert topo["process_count"] == args.nprocs, topo
+    assert topo["local_devices"] == DEVS_PER_PROC, topo
+    assert topo["global_devices"] == args.nprocs * DEVS_PER_PROC, topo
+
+    # the config contract: a pod slice shards over the LOCAL slice
+    class _Cfg:
+        mesh = args.nprocs * DEVS_PER_PROC
+        mesh_hosts = args.nprocs
+        mesh_devices_per_host = DEVS_PER_PROC
+        mesh_platform = "cpu"
+    assert local_mesh_size(_Cfg) == DEVS_PER_PROC
+    result = sharded_vs_serial(DEVS_PER_PROC)
+    result["topology"] = topo
+    print("MESH_SMOKE_RESULT " + json.dumps(result), flush=True)
+    return 0
+
+
+def run_smoke(verbose: bool) -> int:
+    import socket
+
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        coordinator = "127.0.0.1:%d" % s.getsockname()[1]
+
+    procs = []
+    for i in range(2):
+        env = dict(os.environ)
+        env.pop("XLA_FLAGS", None)   # workers set their own device count
+        procs.append(subprocess.Popen(
+            [sys.executable, os.path.abspath(__file__),
+             "--worker", str(i), "--coordinator", coordinator,
+             "--nprocs", "2"],
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+            text=True, env=env))
+    results = []
+    failed = False
+    for i, p in enumerate(procs):
+        out, _ = p.communicate(timeout=300)
+        if verbose or p.returncode != 0:
+            sys.stderr.write(f"--- worker {i} (rc={p.returncode}) ---\n"
+                             f"{out}\n")
+        if p.returncode != 0:
+            failed = True
+            continue
+        for line in out.splitlines():
+            if line.startswith("MESH_SMOKE_RESULT "):
+                results.append(json.loads(
+                    line[len("MESH_SMOKE_RESULT "):]))
+    if failed or len(results) != 2:
+        print(json.dumps({"ok": False, "workers": len(results)}))
+        return 1
+    # both processes saw the same global topology and both proved
+    # sharded == serial with 0 warm recompiles over their local slice
+    assert all(r["topology"]["global_devices"] == 2 * DEVS_PER_PROC
+               for r in results), results
+    assert all(r["bit_exact"] for r in results)
+    assert results[0]["bits_lit"] == results[1]["bits_lit"], \
+        "deterministic stream must light identical frontiers"
+
+    # parent-side: the full 8-virtual-device single-process mesh
+    _force_cpu(8)
+    parent = sharded_vs_serial(8)
+    print(json.dumps({"ok": True, "workers": results,
+                      "parent": parent}))
+    return 0
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--smoke", action="store_true",
+                    help="quiet CI mode (same checks)")
+    ap.add_argument("--worker", type=int, default=-1)
+    ap.add_argument("--coordinator", default="")
+    ap.add_argument("--nprocs", type=int, default=2)
+    args = ap.parse_args(argv)
+    if args.worker >= 0:
+        return run_worker(args)
+    return run_smoke(verbose=not args.smoke)
+
+
+if __name__ == "__main__":
+    sys.exit(main())
