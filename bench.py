@@ -1237,6 +1237,39 @@ def _stage(name):
     sys.stderr.flush()
 
 
+def bench_tsdb(smoke=False):
+    """Fleet-observatory cost: the tsdb rollup is ONE fused dispatch
+    per wall-clock tick (never per exec) folding every stat slot's
+    delta into the three retention tiers, and a scrape is ONE
+    device→host transfer of the (S, W) ring.  Warm recompiles across
+    the run must be 0 — the tick operands are traced scalars."""
+    import jax.numpy as jnp
+
+    from syzkaller_tpu.observe import DeviceTsdb
+    from syzkaller_tpu.telemetry import DeviceStats
+    from syzkaller_tpu.vet.runtime import CompileCounter
+
+    ds = DeviceStats()
+    d = DeviceTsdb([ds])
+    n = 64 if smoke else 1024
+    vec = np.zeros(ds.nslots, np.int32)
+    d.sample_now()                       # build + compile the kernel
+    with CompileCounter() as cc:
+        t0 = time.perf_counter()
+        for _t in range(n):
+            vec[0] += 1
+            # copy: jnp.asarray may alias the numpy buffer on CPU and
+            # vec mutates under the async dispatch
+            ds.vec = jnp.asarray(vec.copy())
+            d.sample_now()
+        dt = time.perf_counter() - t0
+    t1 = time.perf_counter()
+    d.scrape()
+    return {"tsdb_samples_per_sec": round(n / dt, 1),
+            "tsdb_scrape_seconds": round(time.perf_counter() - t1, 5),
+            "tsdb_recompiles_warm": int(cc.count)}
+
+
 def main(argv=None):
     ap = argparse.ArgumentParser()
     ap.add_argument("--smoke", action="store_true",
@@ -1356,6 +1389,8 @@ def main(argv=None):
     extras.update(bench_resilience(smoke=args.smoke))
     _stage("autopilot control plane")
     extras.update(bench_autopilot(smoke=args.smoke))
+    _stage("fleet observatory (tsdb rollup)")
+    extras.update(bench_tsdb(smoke=args.smoke))
     # static-analysis gate trajectory: the BENCH_*.json series records
     # the vet finding counts alongside throughput, so a PR that buys
     # speed by parking P0s in the baseline shows up in the history

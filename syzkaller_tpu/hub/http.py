@@ -43,6 +43,7 @@ def summary(hub, start_time: float) -> str:
             f"<th>pending</th><th>filtered</th><th>covered</th>"
             f"<th>sync age</th></tr>{table}</table>"
             f"<p><a href='/metrics'>metrics</a> | "
+            f"<a href='/origins'>origins</a> | "
             f"<a href='/log'>log</a></p>")
 
 
@@ -69,8 +70,7 @@ def serve(hub, host: str, port: int) -> ThreadingHTTPServer:
                 elif self.path.split("?")[0] == "/metrics":
                     from syzkaller_tpu.telemetry import expo
                     self._send(expo.prometheus_text([hub.registry]),
-                               ctype="text/plain; version=0.0.4; "
-                                     "charset=utf-8")
+                               ctype=expo.CONTENT_TYPE)
                 elif self.path.split("?")[0] == "/healthz":
                     # hub liveness for the same orchestrator probe
                     # contract as the manager's /healthz — 503 when a
@@ -80,6 +80,17 @@ def serve(hub, host: str, port: int) -> ThreadingHTTPServer:
                     code, body = hub.health()
                     self._send(json.dumps(body), code,
                                ctype="application/json")
+                elif self.path.split("?")[0] == "/origins":
+                    # cross-host lineage index: sig -> first pusher's
+                    # {"manager", "trace"} — what the fleet console
+                    # stitches waterfalls from when a program's local
+                    # span has expired from a manager's tracer ring
+                    import json
+                    st = hub.state
+                    self._send(json.dumps(
+                        {"count": len(st.origins),
+                         "origins": dict(list(st.origins.items())[:256])}),
+                        ctype="application/json")
                 elif self.path.startswith("/log"):
                     self._send("<pre>%s</pre>" %
                                html_mod.escape(log.cached_log()))

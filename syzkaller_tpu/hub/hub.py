@@ -159,23 +159,29 @@ class Hub:
                         mismatch) — replaces the stored set
           blocks        list parallel to `add`: each entry the b64
                         LE-u64 block set of that program ("" = unknown)
+          traces        list parallel to `add`: each entry the pushing
+                        manager's trace id ("" = untraced), persisted
+                        so cross-host span lineage survives the hub
 
         and returns `filtered` (programs the sketch withheld this
         call) plus `covered` (hub-side sketch size — the echo managers
         compare against their sent count to detect a hub that lost
-        their sketch and needs a snapshot resync)."""
+        their sketch and needs a snapshot resync) plus `traces` (list
+        parallel to `progs`: each entry the {"manager", "trace"} origin
+        of that program, {} when it arrived untraced)."""
         name = self._auth(params)
         self._ensure_manager_gauges(name)
         add = [rpc.unb64(p) for p in params.get("add", [])]
         blk_wire = params.get("blocks") or []
         blocks = [decode_blocks(b) if b else None for b in blk_wire] \
             if blk_wire else None
+        traces = [str(t) for t in params.get("traces") or []] or None
         sketch = decode_blocks(params.get("sketch", ""))
         with self._mu:
             if len(sketch) or params.get("sketch_reset"):
                 self.state.observe_sketch(
                     name, sketch, reset=bool(params.get("sketch_reset")))
-            fresh = self.state.add(name, add, blocks)
+            fresh = self.state.add(name, add, blocks, traces)
             progs, more, filtered = self.state.pending(name)
             covered = len(self.state.managers[name].covered)
             writes = self.state.take_writes()
@@ -186,8 +192,12 @@ class Hub:
         log.logf(1, "hub: sync %s: +%d fresh, -> %d progs "
                  "(%d more, %d sketch-filtered, %d covered blocks)",
                  name, fresh, len(progs), more, filtered, covered)
+        # origin lookup after the lock: origins is a plain dict keyed
+        # by sig and entries are never mutated in place, so a read
+        # racing a concurrent add at worst misses a brand-new origin
         return {"progs": [rpc.b64(p) for p in progs], "more": more,
-                "filtered": filtered, "covered": covered}
+                "filtered": filtered, "covered": covered,
+                "traces": [self.state.origin_of(p) for p in progs]}
 
     def serve_background(self) -> None:
         self.server.serve_background()

@@ -56,14 +56,20 @@ class HubState:
         self.corpus_dir = os.path.join(dirpath, "corpus")
         self.mgr_dir = os.path.join(dirpath, "managers")
         self.blocks_dir = os.path.join(dirpath, "blocks")
+        self.origin_dir = os.path.join(dirpath, "origins")
         os.makedirs(self.corpus_dir, exist_ok=True)
         os.makedirs(self.mgr_dir, exist_ok=True)
         os.makedirs(self.blocks_dir, exist_ok=True)
+        os.makedirs(self.origin_dir, exist_ok=True)
         # global sequence: list of (sig, data); order = admission order
         self.seq: list[tuple[str, bytes]] = []
         self.sigs: set[str] = set()
         # sig -> covered raw-PC blocks (uint64), when the pusher sent them
         self.blocks: dict[str, np.ndarray] = {}
+        # sig -> {"manager", "trace"}: the pushing manager's span
+        # context, persisted as a sidecar so cross-host lineage survives
+        # a hub restart (the resync path re-ships the same origin)
+        self.origins: dict[str, dict] = {}
         self.managers: dict[str, ManagerState] = {}
         self._writes: list[tuple[str, bytes]] = []   # staged disk writes
         self._load()
@@ -93,6 +99,18 @@ class HubState:
                     self.blocks[name] = np.frombuffer(f.read(), "<u8").copy()
             except OSError:
                 continue
+        for name in os.listdir(self.origin_dir):
+            if name not in self.sigs:
+                continue
+            try:
+                with open(os.path.join(self.origin_dir, name)) as f:
+                    origin = json.load(f)
+            except (OSError, json.JSONDecodeError):
+                continue
+            if isinstance(origin, dict) and origin.get("trace"):
+                self.origins[name] = {
+                    "manager": str(origin.get("manager", "")),
+                    "trace": str(origin["trace"])}
         for name in os.listdir(self.mgr_dir):
             path = os.path.join(self.mgr_dir, name)
             if name.endswith(".covered"):
@@ -159,16 +177,19 @@ class HubState:
         self._stage_manager(m)
 
     def add(self, name: str, progs: list[bytes],
-            blocks: "list[np.ndarray | None] | None" = None) -> int:
+            blocks: "list[np.ndarray | None] | None" = None,
+            traces: "list[str] | None" = None) -> int:
         """Programs pushed by a manager (with optional per-program
-        covered-block arrays, parallel to `progs`); returns how many
-        were fresh."""
+        covered-block arrays and trace ids, parallel to `progs`);
+        returns how many were fresh."""
         m = self.managers.setdefault(name, ManagerState(name=name))
         fresh = 0
         for i, data in enumerate(progs):
             sig = hashlib.sha1(data).hexdigest()
             bl = blocks[i] if blocks is not None and i < len(blocks) \
                 else None
+            tid = traces[i] if traces is not None and i < len(traces) \
+                else ""
             if bl is not None and len(bl) and sig not in self.blocks:
                 # a known program gaining a block sketch still helps:
                 # it becomes filterable for future pulls
@@ -176,6 +197,13 @@ class HubState:
                 self._writes.append((
                     os.path.join(self.blocks_dir, sig),
                     self.blocks[sig].astype("<u8").tobytes()))
+            if tid and sig not in self.origins:
+                # first pusher wins: lineage points at the manager that
+                # actually discovered the program
+                self.origins[sig] = {"manager": name, "trace": str(tid)}
+                self._writes.append((
+                    os.path.join(self.origin_dir, sig),
+                    json.dumps(self.origins[sig]).encode()))
             if sig in self.sigs:
                 continue
             self.sigs.add(sig)
@@ -239,6 +267,12 @@ class HubState:
         m.last_sync = time.time()
         self._stage_manager(m)
         return out, more, filtered
+
+    def origin_of(self, data: bytes) -> dict:
+        """{"manager", "trace"} of the program's first pusher (empty
+        dict when it arrived without a span context).  Plain dict read
+        — safe to call after the hub lock is released."""
+        return self.origins.get(hashlib.sha1(data).hexdigest(), {})
 
     def sync_age(self, name: str) -> float:
         """Seconds since the manager's last Hub.Sync (inf if never)."""

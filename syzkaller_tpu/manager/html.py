@@ -41,9 +41,9 @@ def serve(mgr, host: str, port: int) -> ThreadingHTTPServer:
                     self._send(summary(mgr))
                 elif u.path == "/metrics":
                     # Prometheus text exposition (telemetry/expo.py)
+                    from syzkaller_tpu.telemetry import expo
                     self._send(mgr.metrics_text(),
-                               ctype="text/plain; version=0.0.4; "
-                                     "charset=utf-8")
+                               ctype=expo.CONTENT_TYPE)
                 elif u.path == "/telemetry":
                     import json
                     self._send(json.dumps(mgr.telemetry_snapshot(),
@@ -66,6 +66,23 @@ def serve(mgr, host: str, port: int) -> ThreadingHTTPServer:
                     self._send(prio(mgr, q.get("call", [""])[0]))
                 elif u.path == "/cover":
                     self._send(cover(mgr, q.get("call", [""])[0]))
+                elif u.path == "/tsdb":
+                    # the observatory's retained time-series windows:
+                    # one device->host transfer per scrape tick, served
+                    # from the cached ring (observe/tsdb.py)
+                    import json
+                    ts = getattr(mgr, "tsdb", None)
+                    self._send(json.dumps(
+                        ts.snapshot_json() if ts is not None else {},
+                        default=str), ctype="application/json")
+                elif u.path == "/profile/dispatches":
+                    # per-dispatch wall-latency histograms + recompile
+                    # attribution (observe/profile.py)
+                    import json
+                    prof = getattr(mgr, "dispatch_profiler", None)
+                    self._send(json.dumps(
+                        prof.snapshot() if prof is not None else {},
+                        default=str), ctype="application/json")
                 elif u.path == "/profile":
                     self._send(profile(mgr, q.get("sec", ["3"])[0]))
                 elif u.path == "/log":
@@ -115,7 +132,10 @@ def summary(mgr) -> str:
             f"<a href='/cover'>coverage</a> | "
             f"<a href='/metrics'>metrics</a> | "
             f"<a href='/telemetry'>telemetry</a> | "
-            f"<a href='/profile'>profile</a> | <a href='/log'>log</a></p>"
+            f"<a href='/tsdb'>tsdb</a> | "
+            f"<a href='/profile'>profile</a> | "
+            f"<a href='/profile/dispatches'>dispatches</a> | "
+            f"<a href='/log'>log</a></p>"
             f"<h3>Stats</h3><table>{rows}</table>"
             f"<h3>Crashes</h3><table><tr><th>description</th><th>count</th>"
             f"</tr>{crows}</table>")

@@ -127,6 +127,24 @@ class Manager:
             # the triage similarity matmul rides the same mesh (report
             # batch row-sharded; labels bit-exact either way)
             self.crash_index.kernel.shard(mesh)
+        # fleet observatory (observe/): the device time-series store
+        # samples the stat vectors the fused dispatches already bump
+        # (one rollup dispatch per second from the run loop, never per
+        # exec), and the dispatch profiler wraps the engine's jitted
+        # closures BEFORE any failover proxy so every backend's
+        # dispatches are attributed
+        self.tsdb = None
+        self.dispatch_profiler = None
+        if cfg.telemetry:
+            from syzkaller_tpu.observe import DeviceTsdb, DispatchProfiler
+            self.tsdb = DeviceTsdb(
+                [self.device_stats, self.triage_stats],
+                put=self.engine.put_replicated)
+            self.dispatch_profiler = DispatchProfiler()
+            self.dispatch_profiler.register_metrics(self.registry)
+            self.dispatch_profiler.attach(self.engine)
+        from syzkaller_tpu.observe import register_slo_gauges
+        register_slo_gauges(self.registry, self)
         if cfg.backend_failover:
             # the resilience supervisor: device dispatch faults
             # quarantine the backend, migrate engine state to a
@@ -195,6 +213,13 @@ class Manager:
         # the block ids already sent, for hub-loss detection/resync
         self._hub_sketch_sent = 0
         self._hub_blocks_sent: set[int] = set()
+        # cross-host trace stitching: sig -> (origin trace id, origin
+        # manager) for programs pulled from the hub, so the local
+        # admission span links back to the admitting span on the origin
+        # manager (bounded like the idempotency window)
+        self._hub_origins: "OrderedDict[bytes, tuple[str, str]]" = \
+            OrderedDict()
+        self._last_hub_sync_wall = 0.0
         self._repro_active: set[str] = set()
         self._repro_block = 0          # unique index block per repro job
         # ONE shared batched-bisection service + VM pool for every
@@ -431,6 +456,13 @@ class Manager:
             except Exception as e:
                 log.logf(1, "frontier view %s restore failed: %s", tag, e)
         self._snapshot_triage = st
+        # tsdb rings ride the same snapshot: a crash-only restart
+        # resumes the retained series instead of a blank history
+        if self.tsdb is not None and st.meta.get("tsdb"):
+            try:
+                self.tsdb.import_state(st.meta["tsdb"], st.arrays)
+            except Exception as e:
+                log.logf(1, "tsdb restore failed (fresh rings): %s", e)
         # resume the snapshot cadence from the RESTORED snapshot's
         # timestamp, not from process start: restarting from zero made
         # the cadence drift by one restart each crash (and left a
@@ -693,10 +725,13 @@ class Manager:
                 self.tracer.record(ctx, final_hop=f"manager:{method}",
                                    dur=seconds)
 
-    def telemetry_snapshot(self, traces: int = 16) -> dict:
+    def telemetry_snapshot(self, traces: int = 64) -> dict:
         """JSON-ready snapshot of the registry, device stat vectors
         (engine + triage, merged), and recent trace spans (the
-        /telemetry endpoint + persistence body)."""
+        /telemetry endpoint + persistence body).  The trace window is
+        sized so the fleet console can stitch cross-host lineage — an
+        origin span must still be visible here when the pulling
+        manager's linked span shows up on another host."""
         return expo.snapshot([self.registry],
                              [self.device_stats, self.triage_stats],
                              self.tracer, traces=traces)
@@ -886,6 +921,15 @@ class Manager:
         trace = telemetry.SpanContext.from_wire(params.get("trace"))
         if trace is not None:
             trace.mark_transit()
+            # cross-host stitching: a program pulled from the hub links
+            # its local admission span to the admitting span on the
+            # origin manager (A -> hub -> B keeps one lineage chain);
+            # done here so the serial AND coalesced paths both record it
+            with self._mu:
+                origin = self._hub_origins.get(sig)
+            if origin is not None and origin[0] not in trace.links:
+                trace.links.append(origin[0])
+                trace.add_hop(f"hub:from {origin[1] or '?'}", 0.0)
         if self.coalescer is not None:
             # batched admission plane: enqueue and block on the ticket;
             # the drainer aggregates concurrent NewInputs into one fused
@@ -1049,6 +1093,10 @@ class Manager:
                 self._hub_synced.add(sig)
         req = {"name": self.cfg.name, "key": self.cfg.hub_key,
                "add": [rpc.b64(d) for d in new]}
+        # cross-host stitching: each pushed program's admitting trace id
+        # rides beside it (parallel to `add`), so the hub can hand the
+        # lineage to whichever manager pulls it later
+        req["traces"] = [it.trace_id for it in fresh_items]
         if self.cfg.hub_sketch:
             req["blocks"] = blocks
             sketch, reset = self._hub_sketch_delta()
@@ -1061,14 +1109,33 @@ class Manager:
         while True:
             r = self._hub_client.call("Hub.Sync", req)
             filtered += int(r.get("filtered", 0))
-            for pd in r.get("progs", []):
+            wire_traces = r.get("traces") or []
+            for i, pd in enumerate(r.get("progs", [])):
                 data = rpc.unb64(pd)
                 sig = hashlib.sha1(data).digest()
+                origin = wire_traces[i] if i < len(wire_traces) else None
+                tid = (origin or {}).get("trace", "")
+                omgr = (origin or {}).get("manager", "")
                 with self._mu:
+                    if tid:
+                        # remember the origin span for the admission-
+                        # time link, bounded like the idem window
+                        self._hub_origins[sig] = (tid, omgr)
+                        while len(self._hub_origins) > IDEM_CACHE:
+                            self._hub_origins.popitem(last=False)
                     if sig in self.corpus:
                         continue
                     self.candidates.append(data)
                     pulled += 1
+                if tid:
+                    # pull-time lineage span: the cross-host chain is
+                    # visible in /telemetry even before (or without)
+                    # the replayed program re-admitting locally
+                    ctx = self.tracer.new_trace(origin=self.cfg.name)
+                    ctx.links.append(tid)
+                    ctx.add_hop(f"hub:shipped from {omgr or '?'}", 0.0)
+                    self.tracer.record(
+                        ctx, final_hop="manager:candidate", dur=0.0)
             covered = r.get("covered")
             if self.cfg.hub_sketch and covered is not None \
                     and covered < len(self._hub_blocks_sent):
@@ -1085,6 +1152,7 @@ class Manager:
             # drain the backlog: pushes/sketch went with round one
             req = {"name": self.cfg.name, "key": self.cfg.hub_key,
                    "add": []}
+        self._last_hub_sync_wall = time.time()
         if new or pulled or filtered:
             log.logf(0, "hub sync: sent %d, received %d "
                      "(%d sketch-filtered, %d more)", len(new), pulled,
@@ -1458,6 +1526,11 @@ class Manager:
                 time.sleep(1.0)
                 if deadline and time.time() > deadline:
                     break
+                if self.tsdb is not None:
+                    # one fused rollup dispatch per interval (wall-
+                    # clock, never per exec): the retained series the
+                    # console sparklines and SLO windows read
+                    self.tsdb.maybe_sample()
                 if time.time() - last_stats > 10.0:
                     last_stats = time.time()
                     execs = self.stats.get("exec total", 0)

@@ -37,6 +37,27 @@ from syzkaller_tpu.autopilot.policy import SampleView
 HOST_DOWN = "host_down"
 SYNC_STALLED = "hub_sync_stalled"
 SHIP_STALLED = "hub_ship_stalled"
+COVERAGE_STALLED = "coverage_stalled"
+RING_FULL = "ingest_ring_full"
+
+
+def slo_flags(slo: dict, coverage_stall: float = 300.0,
+              sync_stall: float = 300.0,
+              ring_full_rate: float = 1.0) -> "list[str]":
+    """Flag names raised by one manager's syz_slo_* gauge sample
+    (observe/profile.py publishes them; the manager's own tsdb computes
+    the windows).  This is THE verdict function: ManagedHost.tick and
+    the fleet console both call it, so a console flag always matches
+    the autopilot's."""
+    flags = []
+    if slo.get("syz_slo_coverage_stall_seconds", 0.0) > coverage_stall:
+        flags.append(COVERAGE_STALLED)
+    if sync_stall > 0 and \
+            slo.get("syz_slo_hub_sync_stall_seconds", 0.0) > sync_stall:
+        flags.append(SYNC_STALLED)
+    if slo.get("syz_slo_ingest_ring_full_rate", 0.0) > ring_full_rate:
+        flags.append(RING_FULL)
+    return flags
 
 
 class HubWatch:
@@ -105,11 +126,17 @@ class ManagedHost:
         finally:
             self.pilot.source = orig
         worst = self.pilot.health.worst()
+        # the syz_slo_* burn-rate gauges (observe/profile.py) ride the
+        # same scrape: the manager's tsdb already computed the windows,
+        # so the fleet layer consumes verdicts instead of recomputing
+        slo = {k.split("{", 1)[0]: float(v) for k, v in sample.items()
+               if k.startswith("syz_slo_")}
         return {"host": self.name, "reachable": True,
                 "state": worst.name, "shards": self.shards,
                 "vm_live": sample.get("syz_vm_pool_live"),
                 "vm_target": sample.get("syz_vm_pool_target"),
                 "exec_rate": sample.get("syz_exec_rate", 0.0),
+                "slo": slo, "slo_flags": slo_flags(slo),
                 "report": report}
 
 
@@ -154,6 +181,9 @@ class FleetAutopilot:
             "worst": self._worst(per_host),
             "pool": self._pool_decision(per_host),
             "rotation": self._rotation_decision(per_host),
+            "slo_flags": [{"host": h["host"], "issue": f}
+                          for h in per_host
+                          for f in h.get("slo_flags", [])],
         }
         if self.hub is not None:
             try:

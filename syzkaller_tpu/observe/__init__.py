@@ -1,0 +1,33 @@
+"""Fleet observatory: the production observability tier above the
+per-manager telemetry plane (README "Fleet observatory").
+
+Three parts:
+
+- tsdb:     a device-resident time-series ring store in the
+            DeviceKeyMirror fixed-capacity style — an (S, W) window
+            matrix fed from the DeviceStats slot vector (bumped inside
+            the engine's already-fused dispatches), rolled up by ONE
+            fused kernel into 1s/15s/5min retention tiers, scraped in
+            one transfer, bit-exact against a numpy host shadow, and
+            persisted through the crash-only snapshot path.
+- profile:  named-dispatch profiling over the engine's jitted closures
+            (per-dispatch wall-latency log2 histograms + per-site
+            recompile attribution) and the syz_slo_* burn-rate gauges
+            the fleet autopilot consumes.
+- console:  the live fleet console aggregating /metrics + /telemetry +
+            /healthz (+ /tsdb) from N managers and the hub through the
+            HttpSource seam, with cross-host trace stitching rendered
+            as waterfalls (tools/console.py is the CLI).
+"""
+
+from syzkaller_tpu.observe.console import FleetConsole, HostClient
+from syzkaller_tpu.observe.profile import (
+    DISPATCH_ATTRS, DispatchProfiler, register_slo_gauges)
+from syzkaller_tpu.observe.tsdb import (
+    TIERS, DeviceTsdb, HostTsdb, window_width)
+
+__all__ = [
+    "DISPATCH_ATTRS", "DeviceTsdb", "DispatchProfiler", "FleetConsole",
+    "HostClient", "HostTsdb", "TIERS", "register_slo_gauges",
+    "window_width",
+]

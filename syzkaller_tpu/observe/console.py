@@ -1,0 +1,350 @@
+"""The live fleet console: the production UI tier above per-manager
+pages (ref syz-manager/html.go stays per-host; this is the roll-up).
+
+Aggregates /metrics + /telemetry + /healthz (+ /tsdb) from N managers
+and the hub through the same seam the fleet autopilot scrapes
+(autopilot/controller.HttpSource — parse_prometheus_text over a URL),
+and renders:
+
+  - per-manager coverage-growth sparklines (tsdb tier-0 window of the
+    device admission-gate counter),
+  - crash-cluster / repro / VM / autopilot health summaries,
+  - hub sync ages + corpus, with SLO flags computed by the SAME code
+    the autopilot runs (mesh/fleet.HubWatch + mesh/fleet.slo_flags), so
+    a console flag always matches the autopilot's own verdict,
+  - cross-host trace lineage: spans whose `links` point at a trace
+    recorded on another manager (a program shipped A -> hub -> B) are
+    stitched into one waterfall.
+
+Crash-only semantics: when a host stops answering, its panel flips to
+host_down and its last-seen series FREEZE (kept from the previous
+scrape) — history is never dropped because a host died.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+import urllib.error
+import urllib.request
+
+from syzkaller_tpu.telemetry import expo
+
+
+class HostClient:
+    """One scrape target.  `fetch(url) -> bytes` is injectable so tests
+    and the chaos harness drive the console without sockets."""
+
+    def __init__(self, name: str, base_url: str, fetch=None,
+                 timeout: float = 5.0):
+        self.name = name
+        self.base_url = base_url.rstrip("/")
+        self.timeout = timeout
+        self._fetch = fetch
+
+    def _get(self, path: str) -> bytes:
+        url = self.base_url + path
+        if self._fetch is not None:
+            return self._fetch(url)
+        with urllib.request.urlopen(url, timeout=self.timeout) as resp:
+            return resp.read()
+
+    def metrics(self) -> dict:
+        return expo.parse_prometheus_text(self._get("/metrics").decode())
+
+    def telemetry(self) -> dict:
+        return json.loads(self._get("/telemetry").decode())
+
+    def healthz(self) -> dict:
+        # non-200 still carries the health body; urllib raises on it,
+        # so read the error payload too
+        try:
+            return json.loads(self._get("/healthz").decode())
+        except urllib.error.HTTPError as e:        # degraded = 503
+            return json.loads(e.read().decode())
+
+    def tsdb(self) -> dict:
+        try:
+            return json.loads(self._get("/tsdb").decode())
+        except Exception:
+            return {}               # pre-observatory manager
+
+
+def _metric(sample: dict, name: str, default: float = 0.0) -> float:
+    v = sample.get(name)
+    return default if v is None else float(v)
+
+
+class FleetConsole:
+    """Scrape-state machine over N managers + one hub."""
+
+    def __init__(self, managers, hub_url: "str | None" = None,
+                 sync_age_threshold: float = 300.0,
+                 coverage_stall_threshold: float = 300.0,
+                 fetch=None, timeout: float = 5.0):
+        self.clients = [HostClient(name, url, fetch=fetch,
+                                   timeout=timeout)
+                        for name, url in managers]
+        self.hub_url = hub_url.rstrip("/") if hub_url else None
+        self.sync_age_threshold = float(sync_age_threshold)
+        self.coverage_stall_threshold = float(coverage_stall_threshold)
+        self._hub_watch = None
+        if self.hub_url:
+            from syzkaller_tpu.autopilot.controller import HttpSource
+            from syzkaller_tpu.mesh.fleet import HubWatch
+            src = HttpSource(self.hub_url + "/metrics", timeout=timeout)
+            if fetch is not None:
+                src.sample = lambda u=self.hub_url + "/metrics": \
+                    expo.parse_prometheus_text(fetch(u).decode())
+            self._hub_watch = HubWatch(
+                src, sync_age_threshold=self.sync_age_threshold)
+        self._hub_client = (HostClient("hub", self.hub_url, fetch=fetch,
+                                       timeout=timeout)
+                            if self.hub_url else None)
+        # frozen per-host state survives scrape failures
+        self._state: "dict[str, dict]" = {}
+        self._hub_state: "dict | None" = None
+
+    # -- scraping ----------------------------------------------------------
+
+    def _scrape_host(self, cli: HostClient) -> dict:
+        prev = self._state.get(cli.name)
+        try:
+            sample = cli.metrics()
+            telem = cli.telemetry()
+            health = cli.healthz()
+            tsdb = cli.tsdb()
+        except Exception as e:
+            if prev is not None:
+                # crash-only console: freeze, don't lose
+                out = dict(prev)
+                out.update(host_down=True, frozen=True, error=str(e))
+                return out
+            return {"host": cli.name, "url": cli.base_url,
+                    "host_down": True, "frozen": False, "error": str(e),
+                    "sample": {}, "traces": [], "spark": [],
+                    "summary": {}, "slo": {}, "slo_flags": []}
+        from syzkaller_tpu.mesh.fleet import slo_flags
+        slo = {k.split("{", 1)[0]: float(v) for k, v in sample.items()
+               if k.startswith("syz_slo_")}
+        spark = []
+        for tier in tsdb.get("tiers", []):
+            if tier.get("seconds") == 1:
+                spark = tier.get("series", {}).get("admit_admitted", [])
+        summary = {
+            "corpus": int(_metric(sample, "syz_corpus_size")),
+            "corpus_rows": int(_metric(sample, "syz_engine_corpus_rows")),
+            "exec_rate": round(_metric(sample, "syz_exec_rate"), 2),
+            "fuzzers": int(_metric(sample, "syz_fuzzers_connected")),
+            "crashes": int(_metric(sample, "syz_crash_total")),
+            "crash_clusters": int(_metric(sample, "syz_crash_clusters")),
+            "vm_live": int(_metric(sample, "syz_vm_pool_live")),
+            "vm_target": int(_metric(sample, "syz_vm_pool_target")),
+            "uptime": round(_metric(sample, "syz_uptime_seconds"), 1),
+        }
+        return {
+            "host": cli.name, "url": cli.base_url, "host_down": False,
+            "frozen": False, "sample": sample,
+            "traces": telem.get("traces", []),
+            "health": health, "spark": spark, "summary": summary,
+            "slo": slo,
+            "slo_flags": slo_flags(
+                slo, coverage_stall=self.coverage_stall_threshold,
+                sync_stall=self.sync_age_threshold),
+            "tsdb_tick": tsdb.get("tick", 0),
+            "scraped_at": time.time(),
+        }
+
+    def _scrape_hub(self) -> "dict | None":
+        if self._hub_client is None:
+            return None
+        try:
+            sample = expo.parse_prometheus_text(
+                self._hub_client._get("/metrics").decode())
+            health = self._hub_client.healthz()
+        except Exception as e:
+            out = dict(self._hub_state or {"sample": {}, "health": {}})
+            out.update(host_down=True, frozen=self._hub_state is not None,
+                       error=str(e), flags=out.get("flags", []))
+            return out
+        flags = []
+        watch = {}
+        if self._hub_watch is not None:
+            try:
+                # the autopilot's OWN verdict function over the same
+                # /metrics body — console flags match by construction
+                watch = self._hub_watch.check()
+                flags = watch.get("flags", [])
+            except Exception:
+                pass
+        ages = {}
+        for k, v in sample.items():
+            if k.startswith("syz_hub_sync_age_seconds"):
+                mgr = "?"
+                if "{" in k:
+                    mgr = k.split('manager="', 1)[-1].split('"', 1)[0]
+                ages[mgr] = round(float(v), 1)
+        return {"host": "hub", "url": self.hub_url, "host_down": False,
+                "frozen": False, "sample": sample, "health": health,
+                "sync_ages": ages, "flags": flags, "watch": watch,
+                "corpus": int(_metric(sample, "syz_hub_corpus_size")),
+                "managers": int(_metric(sample, "syz_hub_managers"))}
+
+    def scrape(self) -> dict:
+        for cli in self.clients:
+            self._state[cli.name] = self._scrape_host(cli)
+        self._hub_state = self._scrape_hub()
+        return self.fleet_json()
+
+    # -- views -------------------------------------------------------------
+
+    def _lineage(self) -> "list[dict]":
+        """Stitch cross-host span chains: any trace whose `links` name
+        a trace recorded on ANOTHER host becomes one lineage entry
+        (program admitted on origin, shipped via the hub, replayed
+        here)."""
+        by_id: "dict[str, tuple[str, dict]]" = {}
+        for host, st in self._state.items():
+            for tr in st.get("traces", []):
+                tid = tr.get("trace_id")
+                if tid:
+                    by_id[tid] = (host, tr)
+        out = []
+        for host, st in self._state.items():
+            for tr in st.get("traces", []):
+                for link in tr.get("links", []):
+                    origin = by_id.get(link)
+                    if origin is None or origin[0] == host:
+                        continue
+                    out.append({
+                        "host": host, "trace": tr.get("trace_id"),
+                        "origin_host": origin[0], "origin_trace": link,
+                        "hops": tr.get("hops", []),
+                        "origin_hops": origin[1].get("hops", []),
+                    })
+        return out
+
+    def fleet_json(self) -> dict:
+        flags = []
+        for name, st in self._state.items():
+            if st.get("host_down"):
+                flags.append({"host": name, "issue": "host_down"})
+            for f in st.get("slo_flags", []):
+                flags.append({"host": name, "issue": f})
+        hub = self._hub_state
+        if hub:
+            for f in hub.get("flags", []):
+                f = dict(f)
+                f.setdefault("host", "hub")
+                flags.append(f)
+            if hub.get("host_down"):
+                flags.append({"host": "hub", "issue": "host_down"})
+        return {
+            "ts": time.time(),
+            "managers": {n: {k: v for k, v in st.items()
+                             if k not in ("sample", "traces")}
+                         for n, st in self._state.items()},
+            "hub": ({k: v for k, v in hub.items() if k != "sample"}
+                    if hub else None),
+            "lineage": self._lineage(),
+            "flags": flags,
+        }
+
+    # -- HTML --------------------------------------------------------------
+
+    def render_html(self) -> str:
+        import html as H
+        fleet = self.fleet_json()
+
+        def spark_svg(vals, w=180, h=28) -> str:
+            vals = [float(v) for v in (vals or [])][-60:]
+            if not vals:
+                return "<svg width='%d' height='%d'></svg>" % (w, h)
+            top = max(max(vals), 1.0)
+            n = max(len(vals) - 1, 1)
+            pts = " ".join(
+                f"{i * w / n:.1f},{h - 2 - (v / top) * (h - 4):.1f}"
+                for i, v in enumerate(vals))
+            return (f"<svg width='{w}' height='{h}'>"
+                    f"<polyline points='{pts}' fill='none' "
+                    f"stroke='#2a7' stroke-width='1.5'/></svg>")
+
+        rows = []
+        for name, st in sorted(fleet["managers"].items()):
+            s = st.get("summary", {})
+            state = "HOST_DOWN" if st.get("host_down") else \
+                st.get("health", {}).get("status", "?")
+            cls = "down" if st.get("host_down") else ""
+            frozen = " (frozen series)" if st.get("frozen") else ""
+            flags = ", ".join(st.get("slo_flags", [])) or "-"
+            rows.append(
+                f"<tr class='{cls}'><td><a href='{H.escape(st.get('url', ''))}'>"
+                f"{H.escape(name)}</a></td>"
+                f"<td>{H.escape(str(state))}{frozen}</td>"
+                f"<td>{s.get('corpus', '?')}</td>"
+                f"<td>{s.get('exec_rate', '?')}</td>"
+                f"<td>{s.get('crash_clusters', '?')}/"
+                f"{s.get('crashes', '?')}</td>"
+                f"<td>{s.get('vm_live', '?')}/{s.get('vm_target', '?')}</td>"
+                f"<td>{spark_svg(st.get('spark'))}</td>"
+                f"<td>{H.escape(flags)}</td></tr>")
+
+        hub = fleet.get("hub")
+        hub_html = "<p>no hub configured</p>"
+        if hub:
+            ages = ", ".join(f"{H.escape(k)}: {v}s"
+                             for k, v in sorted(
+                                 hub.get("sync_ages", {}).items())) or "-"
+            hflags = ", ".join(f.get("issue", "?")
+                               for f in hub.get("flags", [])) or "-"
+            state = "HOST_DOWN" if hub.get("host_down") else \
+                hub.get("health", {}).get("status", "?")
+            hub_html = (f"<p>hub <b>{H.escape(str(state))}</b> — corpus "
+                        f"{hub.get('corpus', '?')}, managers "
+                        f"{hub.get('managers', '?')}; sync ages: {ages}; "
+                        f"flags: {H.escape(hflags)}</p>")
+
+        waterfalls = []
+        for ln in fleet["lineage"][:16]:
+            bars = []
+            for who, hops in ((ln["origin_host"], ln["origin_hops"]),
+                              (ln["host"], ln["hops"])):
+                for hop in hops:
+                    us = int(hop.get("dur_us", 0))
+                    wpx = min(300, max(2, us // 100))
+                    bars.append(
+                        f"<div class='hop'><span class='who'>"
+                        f"{H.escape(str(who))}</span> "
+                        f"{H.escape(str(hop.get('name', '?')))} "
+                        f"<span class='bar' style='width:{wpx}px'></span> "
+                        f"{us}&micro;s</div>")
+            waterfalls.append(
+                f"<div class='trace'><b>{H.escape(str(ln['origin_trace']))}"
+                f"</b> @{H.escape(str(ln['origin_host']))} &rarr; hub "
+                f"&rarr; <b>{H.escape(str(ln['trace']))}</b> "
+                f"@{H.escape(str(ln['host']))}{''.join(bars)}</div>")
+
+        fleet_flags = ", ".join(
+            f"{f.get('host', '?')}:{f.get('issue', '?')}"
+            for f in fleet["flags"]) or "none"
+        return f"""<!doctype html><html><head><title>fleet console</title>
+<style>
+body {{ font-family: monospace; margin: 1em; }}
+table {{ border-collapse: collapse; }}
+td, th {{ border: 1px solid #999; padding: 2px 8px; text-align: left; }}
+tr.down td {{ background: #fdd; }}
+.trace {{ border: 1px solid #ccc; margin: 4px 0; padding: 4px; }}
+.hop .bar {{ display: inline-block; height: 8px; background: #47a; }}
+.hop .who {{ color: #888; }}
+</style></head><body>
+<h2>fleet console</h2>
+<p>flags: {H.escape(fleet_flags)}</p>
+{hub_html}
+<h3>managers ({len(fleet['managers'])})</h3>
+<table><tr><th>manager</th><th>state</th><th>corpus</th>
+<th>exec/s</th><th>clusters/crashes</th><th>vms</th>
+<th>new cov (60s)</th><th>slo flags</th></tr>
+{''.join(rows)}</table>
+<h3>cross-host lineage ({len(fleet['lineage'])})</h3>
+{''.join(waterfalls) or '<p>no hub-shipped traces yet</p>'}
+</body></html>"""

@@ -1,0 +1,255 @@
+"""Dispatch-level profiling + the syz_slo_* burn-rate gauges.
+
+The engine's _build() assigns its jitted closures to ~27 well-known
+`_*_fn` attributes; `DispatchProfiler.attach` wraps each present one
+with a timing shim so every device dispatch gets
+
+  - a per-dispatch wall-latency log2 histogram (dispatch-call time:
+    argument staging + enqueue; first call includes the compile), and
+  - per-site recompile attribution: a process-global jax.monitoring
+    listener (the CompileCounter mechanism — register once, never
+    unregister) charges each backend_compile event to the dispatch
+    name active on the compiling thread, or "other" when none is.
+
+The wrapper passes *args/**kwargs straight through, so donation and
+sharding semantics of the wrapped jit are untouched, and re-running
+`attach` after an engine `shard()`/failover rebuild is idempotent
+(already-wrapped attributes are skipped by marker).
+
+`register_slo_gauges` publishes the burn-rate views HubWatch and the
+fleet autopilot consume instead of recomputing ad hoc:
+
+  syz_slo_coverage_stall_seconds   time since the device admission gate
+                                   last admitted new coverage (tsdb
+                                   tier scan, so it spans ~4h)
+  syz_slo_ingest_ring_full_rate    ingest ring-full drops/s over the
+                                   last 15s tsdb window
+  syz_slo_shed_rate                coalescer sheds/s, self-sampled
+                                   scrape-to-scrape
+  syz_slo_hub_sync_stall_seconds   time since the last successful
+                                   Hub.Sync (0 when no hub configured)
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+import numpy as np
+
+from syzkaller_tpu.telemetry.registry import log2_bucket
+
+NBUCKETS = 24
+HIST_BASE = 1e-6
+
+# every jitted closure cover/engine.py:_build() publishes; attach()
+# skips names a particular engine build doesn't have
+DISPATCH_ATTRS = (
+    "_synth_fn", "_random_bits_fn", "_ingest_update_fn",
+    "_ingest_admit_fn", "_ingest_diff_fn", "_ingest_pack_fn",
+    "_ingest_pack_or_fn", "_decision_fn", "_popcount_fn", "_pack_fn",
+    "_pack_or_fn", "_update_stream_fn", "_update_stream32_fn",
+    "_admit_selected_fn", "_update_fn", "_update_sparse_fn",
+    "_admit_if_new_fn", "_admit_choices_fn", "_or_rows_fn",
+    "_diff_vs_fn", "_admit_fn", "_minimize_fn", "_minimize_scan_fn",
+    "_sample_rows_fn", "_compact_fn", "_sample_fn", "_prio_update_fn",
+)
+
+_COMPILE_EVENT = "backend_compile"
+_reg_mu = threading.Lock()
+_registered = False
+_profilers: "list[DispatchProfiler]" = []
+
+
+def _listener(event: str, duration: float = 0.0, **kwargs) -> None:
+    if _COMPILE_EVENT not in event:
+        return
+    for p in list(_profilers):
+        p._on_compile()
+
+
+def _ensure_listener() -> None:
+    global _registered
+    with _reg_mu:
+        if _registered:
+            return
+        _registered = True
+    import jax.monitoring
+    jax.monitoring.register_event_duration_secs_listener(_listener)
+
+
+class DispatchProfiler:
+    """Named-dispatch wall-latency histograms + recompile attribution."""
+
+    def __init__(self):
+        self._mu = threading.Lock()
+        self._tls = threading.local()
+        self._hist: "dict[str, np.ndarray]" = {}
+        self._sum: "dict[str, float]" = {}
+        self._count: "dict[str, int]" = {}
+        self._recompiles: "dict[str, int]" = {}
+        self._families = None
+        _ensure_listener()
+        with _reg_mu:
+            _profilers.append(self)
+
+    # -- wrapping ----------------------------------------------------------
+
+    def _ensure(self, name: str) -> None:
+        if name not in self._hist:
+            self._hist[name] = np.zeros((NBUCKETS,), np.int64)
+            self._sum[name] = 0.0
+            self._count[name] = 0
+
+    def wrap(self, name: str, fn):
+        def wrapped(*args, **kwargs):
+            tls = self._tls
+            prev = getattr(tls, "name", None)
+            tls.name = name
+            t0 = time.perf_counter()
+            try:
+                return fn(*args, **kwargs)
+            finally:
+                dt = time.perf_counter() - t0
+                tls.name = prev
+                b = log2_bucket(dt, HIST_BASE, NBUCKETS)
+                with self._mu:
+                    self._ensure(name)
+                    self._hist[name][b] += 1
+                    self._sum[name] += dt
+                    self._count[name] += 1
+
+        wrapped._syz_dispatch = name
+        wrapped.__wrapped__ = fn
+        return wrapped
+
+    def attach(self, engine) -> "list[str]":
+        """Wrap every present dispatch attribute on `engine`
+        (idempotent); returns the dispatch names now instrumented."""
+        wrapped = []
+        for attr in DISPATCH_ATTRS:
+            fn = getattr(engine, attr, None)
+            if fn is None or not callable(fn):
+                continue
+            name = attr.strip("_")
+            if name.endswith("_fn"):
+                name = name[:-3]
+            if getattr(fn, "_syz_dispatch", None) is not None:
+                wrapped.append(name)
+                continue
+            setattr(engine, attr, self.wrap(name, fn))
+            wrapped.append(name)
+            with self._mu:
+                self._ensure(name)
+        if self._families is not None:
+            self._seed_children(wrapped)
+        return wrapped
+
+    def _on_compile(self) -> None:
+        name = getattr(self._tls, "name", None) or "other"
+        with self._mu:
+            self._recompiles[name] = self._recompiles.get(name, 0) + 1
+
+    # -- exposition --------------------------------------------------------
+
+    def register_metrics(self, registry) -> None:
+        """Per-dispatch gauge families on `registry` (call before
+        attach so children exist from the first scrape; the full log2
+        histograms stay on the /profile/dispatches JSON view — 27x24
+        bucket series would drown /metrics)."""
+        self._families = (
+            registry.gauge("syz_dispatch_calls",
+                           "device dispatches by jitted-closure name",
+                           labels=("dispatch",)),
+            registry.gauge("syz_dispatch_seconds_sum",
+                           "cumulative dispatch-call wall seconds",
+                           labels=("dispatch",)),
+            registry.gauge("syz_dispatch_recompiles",
+                           "XLA compilations attributed to this "
+                           "dispatch site ('other' = unattributed)",
+                           labels=("dispatch",)),
+        )
+        self._seed_children(["other"])
+
+    def _seed_children(self, names) -> None:
+        calls, secs, recomp = self._families
+        for n in names:
+            calls.labels(dispatch=n).set_function(
+                lambda n=n: float(self._count.get(n, 0)))
+            secs.labels(dispatch=n).set_function(
+                lambda n=n: self._sum.get(n, 0.0))
+            recomp.labels(dispatch=n).set_function(
+                lambda n=n: float(self._recompiles.get(n, 0)))
+
+    def snapshot(self) -> dict:
+        """JSON body of /profile/dispatches."""
+        import math
+        bounds = [HIST_BASE * (1 << i) for i in range(NBUCKETS - 1)] \
+            + [math.inf]
+        with self._mu:
+            return {
+                "upper_bounds": [b if math.isfinite(b) else "+Inf"
+                                 for b in bounds],
+                "dispatches": {
+                    n: {"count": self._count[n],
+                        "sum_seconds": self._sum[n],
+                        "buckets": [int(x) for x in self._hist[n]]}
+                    for n in sorted(self._hist)},
+                "recompiles": dict(sorted(self._recompiles.items())),
+            }
+
+
+def register_slo_gauges(registry, mgr) -> None:
+    """The syz_slo_* burn-rate gauges over one manager.  Closures read
+    live state at scrape time and degrade to 0.0 when the backing
+    plane (tsdb, coalescer, hub) isn't configured."""
+    start = time.time()
+    shed_state = {"t": time.monotonic(), "v": 0.0}
+    shed_mu = threading.Lock()
+
+    def coverage_stall() -> float:
+        ts = getattr(mgr, "tsdb", None)
+        if ts is None or ts.tick == 0:
+            return 0.0
+        return ts.stall_seconds("admit_admitted")
+
+    def ring_full_rate() -> float:
+        ts = getattr(mgr, "tsdb", None)
+        if ts is None or ts.tick == 0:
+            return 0.0
+        return ts.window_rate("ingest_ring_full", seconds=15.0)
+
+    def shed_rate() -> float:
+        now = time.monotonic()
+        v = float(mgr._c_shed.value)
+        with shed_mu:
+            dt = now - shed_state["t"]
+            dv = v - shed_state["v"]
+            if dt >= 1.0:
+                shed_state["t"], shed_state["v"] = now, v
+        if dt < 1.0:
+            return 0.0          # back-to-back scrapes reuse the window
+        return max(0.0, dv) / dt
+
+    def sync_stall() -> float:
+        if not getattr(mgr.cfg, "hub_addr", ""):
+            return 0.0
+        last = getattr(mgr, "_last_hub_sync_wall", 0.0)
+        return time.time() - (last or start)
+
+    registry.gauge(
+        "syz_slo_coverage_stall_seconds",
+        "seconds since the admission gate last admitted new coverage "
+        "(tsdb tier scan)", fn=coverage_stall)
+    registry.gauge(
+        "syz_slo_ingest_ring_full_rate",
+        "ingest ring-full drops per second over the last 15s window",
+        fn=ring_full_rate)
+    registry.gauge(
+        "syz_slo_shed_rate",
+        "coalescer admissions shed per second (scrape-to-scrape)",
+        fn=shed_rate)
+    registry.gauge(
+        "syz_slo_hub_sync_stall_seconds",
+        "seconds since the last successful Hub.Sync (0 without a hub)",
+        fn=sync_stall)

@@ -1,0 +1,318 @@
+"""Device-resident time-series ring store over the DeviceStats vector.
+
+The telemetry plane's gap before this module: every /metrics scrape was
+a point-in-time snapshot — coverage-growth HISTORY (what the bandit
+scheduler trains on, what a console sparkline renders) evaporated
+between scrapes.  This store retains it device-side in the
+DeviceKeyMirror fixed-capacity style: one (S, W) int32 window matrix
+whose S axis is the DeviceStats slot layout and whose W axis is three
+concatenated retention tiers,
+
+    tier 0:  64 columns x  1s   (the last ~minute, full resolution)
+    tier 1:  60 columns x 15s   (the last ~15 minutes)
+    tier 2:  48 columns x 300s  (the last ~4 hours)
+
+The hot path adds NOTHING: counters are bumped inside the engine's
+already-fused dispatches (telemetry/device.py contract), and this
+module only READS that vector — one fused rollup kernel per sampling
+interval (1 Hz from the manager run loop), never per exec.  The kernel
+takes the tick's column indices and tier-fold flags as traced int32/bool
+operands, so a warmed store never recompiles (CompileCounter-pinned in
+tests).  Scrape is ONE device->host transfer of the whole matrix,
+cached ~1s so gauge closures and /tsdb don't multiply transfers.
+
+Delta rule (the part the host shadow must reproduce bit-exactly):
+
+    delta = where(vec >= last, vec - last, vec);  last' = vec
+
+The device vector is monotonic between flushes and drops to zero on
+`flush(reset=True)` (int32 roll-over protection): the `vec < last` arm
+re-bases on the fresh vector.  Counts folded into host cumulatives by
+the reset itself are clipped from at most one sampling interval — the
+series is a rate view, the registry keeps exact totals.
+
+Snapshot/restore: `export_state`/`import_state` ride the PR 9
+checkpoint arrays, so a crash-only restart resumes the rings instead of
+starting a blank history.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+import numpy as np
+
+from syzkaller_tpu.telemetry.device import SCALAR_SLOTS, _nslots
+
+# (seconds per column, columns) per retention tier; tier 0 must be the
+# base sampling cadence and later tiers exact multiples of it
+TIERS = ((1, 64), (15, 60), (300, 48))
+
+_W0, _W1, _W2 = (w for _s, w in TIERS)
+_OFF1 = _W0
+_OFF2 = _W0 + _W1
+_SLOT = {key: i for i, (key, _n, _l) in enumerate(SCALAR_SLOTS)}
+
+
+def window_width() -> int:
+    """Total W of the (S, W) ring matrix."""
+    return sum(w for _s, w in TIERS)
+
+
+def _tick_operands(t: int):
+    """Column indices + fold flags for sample tick `t`, as numpy
+    scalars (traced jit operands — Python ints would also trace, but a
+    consistent dtype avoids weak-type retraces)."""
+    return (np.int32(t % _W0),
+            np.int32(_OFF1 + (t // 15) % _W1),
+            np.int32(_OFF2 + (t // 300) % _W2),
+            np.bool_(t % 15 == 14),
+            np.bool_(t % 300 == 299))
+
+
+def _build_kernel(nvec: int):
+    """The fused rollup: tier-0 delta write + 15s/300s accumulator
+    folds in one dispatch.  Fold writes are computed unconditionally
+    and selected by the traced flags (fixed shapes, zero warm
+    recompiles); the discarded write targets a live column's FUTURE
+    slot, so selecting it away is exact, not approximate."""
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    def step(ring, last, acc15, acc300, c0, c1, c2, f15, f300, *vecs):
+        vec = vecs[0]
+        for v in vecs[1:]:
+            vec = vec + v
+        delta = jnp.where(vec >= last, vec - last, vec)
+        ring = lax.dynamic_update_slice(ring, delta[:, None],
+                                        (jnp.int32(0), c0))
+        acc15 = acc15 + delta
+        acc300 = acc300 + delta
+        ring = jnp.where(
+            f15, lax.dynamic_update_slice(ring, acc15[:, None],
+                                          (jnp.int32(0), c1)), ring)
+        acc15 = jnp.where(f15, jnp.zeros_like(acc15), acc15)
+        ring = jnp.where(
+            f300, lax.dynamic_update_slice(ring, acc300[:, None],
+                                           (jnp.int32(0), c2)), ring)
+        acc300 = jnp.where(f300, jnp.zeros_like(acc300), acc300)
+        return ring, vec, acc15, acc300
+
+    return jax.jit(step)
+
+
+class HostTsdb:
+    """Pure-numpy shadow of the device store: same (S, W) layout, same
+    delta rule, same fold schedule.  Tests drive both with identical
+    vector snapshots and compare rings bit-exactly; it is also the
+    store a telemetry-off component could run host-side."""
+
+    def __init__(self, nslots: "int | None" = None):
+        self.nslots = int(nslots or _nslots())
+        self.ring = np.zeros((self.nslots, window_width()), np.int32)
+        self.last = np.zeros((self.nslots,), np.int32)
+        self.acc15 = np.zeros((self.nslots,), np.int32)
+        self.acc300 = np.zeros((self.nslots,), np.int32)
+        self.tick = 0
+
+    def sample(self, vec) -> None:
+        vec = np.asarray(vec, np.int32)
+        delta = np.where(vec >= self.last, vec - self.last, vec)
+        t = self.tick
+        self.ring[:, t % _W0] = delta
+        self.acc15 += delta
+        self.acc300 += delta
+        if t % 15 == 14:
+            self.ring[:, _OFF1 + (t // 15) % _W1] = self.acc15
+            self.acc15[:] = 0
+        if t % 300 == 299:
+            self.ring[:, _OFF2 + (t // 300) % _W2] = self.acc300
+            self.acc300[:] = 0
+        self.last = vec.copy()
+        self.tick = t + 1
+
+
+class DeviceTsdb:
+    """The device-resident store over one or more DeviceStats vectors
+    (engine + triage; the kernel sums them — /metrics merges the same
+    way, so the series matches the exposition totals' rates)."""
+
+    def __init__(self, stats, interval: float = 1.0, put=None):
+        if not isinstance(stats, (list, tuple)):
+            stats = [stats]
+        self.sources = [s for s in stats if s is not None]
+        self.interval = float(interval)
+        self.nslots = (self.sources[0].nslots if self.sources
+                       else _nslots())
+        self._put = put
+        self._mu = threading.Lock()
+        self._fn = None
+        self.tick = 0
+        self.samples = 0            # successful rollup dispatches
+        self.errors = 0             # sampling failures (failover edge)
+        self.last_wall = 0.0
+        self._last_mono: "float | None" = None
+        self._scrape: "np.ndarray | None" = None
+        self.ring = self._place(
+            np.zeros((self.nslots, window_width()), np.int32))
+        self.last = self._place(np.zeros((self.nslots,), np.int32))
+        self.acc15 = self._place(np.zeros((self.nslots,), np.int32))
+        self.acc300 = self._place(np.zeros((self.nslots,), np.int32))
+
+    def _place(self, arr: np.ndarray):
+        if self._put is not None:
+            return self._put(arr)
+        import jax.numpy as jnp
+        return jnp.asarray(arr)
+
+    # -- sampling ----------------------------------------------------------
+
+    def sample_now(self) -> None:
+        """Advance exactly one tick: ONE fused dispatch reading the
+        live stat vectors (no host transfer of the vectors)."""
+        with self._mu:
+            if self._fn is None:
+                self._fn = _build_kernel(max(1, len(self.sources)))
+            vecs = [s.vec for s in self.sources]
+            if not vecs:
+                vecs = [self.last]      # degenerate: flat series
+            ops = _tick_operands(self.tick)
+            self.ring, self.last, self.acc15, self.acc300 = self._fn(
+                self.ring, self.last, self.acc15, self.acc300,
+                *ops, *vecs)
+            self.tick += 1
+            self.samples += 1
+            self.last_wall = time.time()
+            self._scrape = None
+
+    def maybe_sample(self, now: "float | None" = None) -> bool:
+        """Tick-gated sampling for the manager run loop: at most one
+        rollup per interval, failure-isolated (a quarantined backend
+        mid-failover must not take the run loop down with it)."""
+        now = time.monotonic() if now is None else now
+        with self._mu:
+            if self._last_mono is not None \
+                    and now - self._last_mono < self.interval:
+                return False
+            self._last_mono = now
+        try:
+            self.sample_now()
+            return True
+        except Exception:
+            with self._mu:
+                self.errors += 1
+            return False
+
+    # -- scrape + views ----------------------------------------------------
+
+    def scrape(self) -> np.ndarray:
+        """The whole (S, W) ring, ONE device->host transfer, cached
+        until the next sample so stacked gauge reads don't multiply
+        transfers."""
+        with self._mu:
+            if self._scrape is None:
+                self._scrape = np.asarray(self.ring)
+            return self._scrape
+
+    def _row(self, key: str) -> np.ndarray:
+        return self.scrape()[_SLOT[key]]
+
+    def window(self, key: str, tier: int = 0) -> np.ndarray:
+        """One slot's tier window, oldest -> newest, only the columns
+        that have actually been written."""
+        row = self._row(key)
+        t = self.tick
+        if tier == 0:
+            ticks = range(max(0, t - _W0), t)
+            return np.array([row[i % _W0] for i in ticks], np.int64)
+        if tier == 1:
+            folds = t // 15
+            return np.array([row[_OFF1 + f % _W1]
+                             for f in range(max(0, folds - _W1), folds)],
+                            np.int64)
+        folds = t // 300
+        return np.array([row[_OFF2 + f % _W2]
+                         for f in range(max(0, folds - _W2), folds)],
+                        np.int64)
+
+    def window_rate(self, key: str, seconds: float = 15.0) -> float:
+        """Mean per-second rate of a slot over the last `seconds` of
+        tier-0 history (the SLO burn-rate view)."""
+        w = self.window(key, tier=0)
+        n = min(len(w), max(1, int(round(seconds / self.interval))))
+        if n == 0:
+            return 0.0
+        return float(w[-n:].sum()) / (n * self.interval)
+
+    def stall_seconds(self, key: str) -> float:
+        """Seconds since a slot last moved, scanning fine-to-coarse
+        tiers (tier spans are the resolution bound; clamped to the
+        store's uptime)."""
+        uptime = self.tick * self.interval
+        w0 = self.window(key, tier=0)
+        nz = np.nonzero(w0)[0]
+        if len(nz):
+            return min(uptime, (len(w0) - 1 - nz[-1]) * self.interval)
+        stall = len(w0) * self.interval
+        for tier, span in ((1, 15.0), (2, 300.0)):
+            w = self.window(key, tier=tier)
+            nz = np.nonzero(w)[0]
+            if len(nz):
+                return min(uptime, stall + (len(w) - 1 - nz[-1]) * span)
+            stall += len(w) * span
+        return min(uptime, stall)
+
+    def snapshot_json(self, keys: "list[str] | None" = None) -> dict:
+        """JSON body of the manager's /tsdb endpoint: per-tier series
+        for the scalar slots (histogram slot rows stay device/scrape-
+        only — 24 buckets x 3 tiers of JSON per histogram is console
+        noise)."""
+        if keys is None:
+            keys = [k for k, _n, _l in SCALAR_SLOTS]
+        tiers = []
+        for tier, (sec, cols) in enumerate(TIERS):
+            tiers.append({
+                "seconds": sec, "columns": cols,
+                "series": {k: [int(x) for x in self.window(k, tier)]
+                           for k in keys},
+            })
+        return {"interval": self.interval, "tick": self.tick,
+                "ts": self.last_wall, "samples": self.samples,
+                "errors": self.errors, "tiers": tiers}
+
+    # -- checkpoint plane --------------------------------------------------
+
+    def export_state(self) -> "tuple[dict, dict]":
+        """(meta, arrays) for the snapshot writer — host-canonical, so
+        the restore side re-places on whatever mesh it has."""
+        with self._mu:
+            arrays = {
+                "tsdb_ring": np.asarray(self.ring).astype(np.int32),
+                "tsdb_last": np.asarray(self.last).astype(np.int32),
+                "tsdb_acc15": np.asarray(self.acc15).astype(np.int32),
+                "tsdb_acc300": np.asarray(self.acc300).astype(np.int32),
+            }
+            meta = {"tick": int(self.tick), "last_wall": self.last_wall,
+                    "interval": self.interval}
+        return meta, arrays
+
+    def import_state(self, meta: dict, arrays: dict) -> None:
+        """Resume rings from a snapshot; a layout-mismatched snapshot
+        (slot vector grew since) is skipped — history is an
+        observability aid, never worth bricking a restore."""
+        ring = np.asarray(arrays.get("tsdb_ring"))
+        if ring.shape != (self.nslots, window_width()):
+            return
+        with self._mu:
+            self.ring = self._place(ring.astype(np.int32))
+            self.last = self._place(
+                np.asarray(arrays["tsdb_last"], np.int32))
+            self.acc15 = self._place(
+                np.asarray(arrays["tsdb_acc15"], np.int32))
+            self.acc300 = self._place(
+                np.asarray(arrays["tsdb_acc300"], np.int32))
+            self.tick = int(meta.get("tick", 0))
+            self.last_wall = float(meta.get("last_wall", 0.0))
+            self._scrape = None

@@ -122,6 +122,55 @@ print("telemetry ok: %d series" % len(series))
         if r.returncode != 0:
             raise SystemExit("telemetry smoke failed")
 
+    # fleet console over a live manager + an in-process hub: the fleet
+    # JSON must carry the summary/SLO/flag structure, the HTML must
+    # render, and both /metrics bodies must pass the STRICT Prometheus
+    # text-format parser with the exact exposition content-type.
+    _CONSOLE_SMOKE = r"""
+import tempfile, urllib.request
+from syzkaller_tpu.hub import http as hub_http
+from syzkaller_tpu.hub.hub import Hub
+from syzkaller_tpu.manager import html
+from syzkaller_tpu.manager.config import Config
+from syzkaller_tpu.manager.manager import Manager
+from syzkaller_tpu.observe import FleetConsole
+from syzkaller_tpu.telemetry import expo
+
+cfg = Config(workdir=tempfile.mkdtemp(prefix="syz-presubmit-"),
+             type="local", count=1, descriptions="probe.txt",
+             npcs=1 << 12, corpus_cap=64, http="")
+mgr = Manager(cfg)
+srv = html.serve(mgr, "127.0.0.1", 0)
+hub = Hub(tempfile.mkdtemp(prefix="syz-presubmit-hub-"), key="k")
+hub.serve_background()
+hsrv = hub_http.serve(hub, "127.0.0.1", 0)
+murl = "http://%s:%d" % srv.server_address
+hurl = "http://%s:%d" % hsrv.server_address
+for url in (murl, hurl):
+    with urllib.request.urlopen(url + "/metrics", timeout=10) as resp:
+        assert resp.status == 200
+        ct = resp.headers.get("Content-Type")
+        assert ct == expo.CONTENT_TYPE, "bad /metrics content-type: " + str(ct)
+        expo.parse_prometheus_text_strict(resp.read().decode())
+console = FleetConsole([("m0", murl)], hub_url=hurl)
+fleet = console.scrape()
+m0 = fleet["managers"]["m0"]
+assert not m0["host_down"] and "summary" in m0 and "slo" in m0, m0
+assert "syz_slo_coverage_stall_seconds" in m0["slo"], m0["slo"]
+assert fleet["hub"] is not None and not fleet["hub"]["host_down"]
+page = console.render_html()
+assert "fleet console" in page and "m0" in page
+hsrv.shutdown(); srv.shutdown(); hub.close(); mgr.stop()
+print("console ok: %d managers, hub corpus %s"
+      % (len(fleet["managers"]), fleet["hub"]["corpus"]))
+"""
+
+    def console_smoke():
+        r = subprocess.run([sys.executable, "-c", _CONSOLE_SMOKE],
+                           cwd=root, env=env)
+        if r.returncode != 0:
+            raise SystemExit("console smoke failed")
+
     def chaos_smoke():
         # one SIGKILL/restore cycle against a real manager subprocess
         # (mid-admission-storm kill, snapshot restore + tail replay,
@@ -162,6 +211,14 @@ print("telemetry ok: %d series" % len(series))
         assert hubc["survivor_kept_fuzzing"] \
             and hubc["exchange_false_negatives"] == 0 \
             and hubc["hub_sketch_filtered"] > 0, hubc
+        # fleet-observatory fold-in: the console must see the killed
+        # manager as host_down with series FROZEN, raise the sync-stall
+        # SLO flag the autopilot's own verdict function agrees with,
+        # and stitch cross-host lineage for ≥1 hub-shipped program
+        assert hubc["console_host_down"] \
+            and hubc["console_series_frozen"] \
+            and hubc["console_slo_matches_autopilot"] \
+            and hubc["console_lineage"] >= 1, hubc
         auto = out["autopilot"]
         assert auto["recovered"] and auto["frontier_bit_exact"] \
             and auto["corpus_lost"] == 0 \
@@ -241,6 +298,16 @@ print("telemetry ok: %d series" % len(series))
             "hub sketch produced exchange false negatives"
         assert out["extras"]["hub_sketch_filtered"] > 0, \
             "hub sketch never filtered (naive-equivalent exchange)"
+        # fleet-observatory acceptance: the coalesced admission path
+        # with full telemetry must stay within the overhead envelope
+        # (the full bench tracks the real <5% figure; the smoke gate is
+        # loose because tiny-shape CPU runs are noisy), and the tsdb
+        # rollup must never recompile warm
+        overhead = out["extras"]["telemetry_overhead_pct"]
+        assert overhead < 50, \
+            f"telemetry overhead {overhead}% out of envelope"
+        assert out["extras"]["tsdb_recompiles_warm"] == 0, \
+            "tsdb rollup kernel recompiled warm"
 
     total = 0.0
     total += step("description tables", gen_tables)
@@ -248,6 +315,7 @@ print("telemetry ok: %d series" % len(series))
     total += step("native executor build", build_executor)
     total += step("engine + multichip smoke", engine_smoke)
     total += step("telemetry smoke", telemetry_smoke)
+    total += step("console smoke (fleet observatory)", console_smoke)
     total += step("chaos smoke (kill/restore cycle)", chaos_smoke)
     total += step("mesh smoke (two-process pod seam)", mesh_smoke)
     total += step("bench smoke", bench_smoke)

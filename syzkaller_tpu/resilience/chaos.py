@@ -695,20 +695,27 @@ def _admit_direct(mgr, inp, name: str = "serial") -> dict:
 
 
 def spawn_hub(workdir: str, port: int, key: str = "chaos",
-              log_path: "str | None" = None) -> subprocess.Popen:
-    """Start a hub subprocess on `workdir` serving RPC on `port`."""
+              log_path: "str | None" = None,
+              http_port: "int | None" = None,
+              sync_age: "float | None" = None) -> subprocess.Popen:
+    """Start a hub subprocess on `workdir` serving RPC on `port` (and
+    the status/metrics page on `http_port` when given)."""
     os.makedirs(workdir, exist_ok=True)
     env = dict(os.environ)
     env["JAX_PLATFORMS"] = "cpu"
     env["PYTHONPATH"] = repo_root() + (
         os.pathsep + env["PYTHONPATH"] if env.get("PYTHONPATH") else "")
     logf = open(log_path or os.path.join(workdir, "chaos-hub.log"), "ab")
+    cmd = [sys.executable, "-m", "syzkaller_tpu.hub",
+           "-addr", f"127.0.0.1:{port}", "-workdir", workdir,
+           "-key", key]
+    if http_port:
+        cmd += ["-http", f"127.0.0.1:{http_port}"]
+    if sync_age is not None:
+        cmd += ["-sync-age", str(sync_age)]
     proc = subprocess.Popen(
-        [sys.executable, "-m", "syzkaller_tpu.hub",
-         "-addr", f"127.0.0.1:{port}", "-workdir", workdir,
-         "-key", key],
-        cwd=repo_root(), env=env, stdout=logf, stderr=subprocess.STDOUT,
-        start_new_session=True)
+        cmd, cwd=repo_root(), env=env, stdout=logf,
+        stderr=subprocess.STDOUT, start_new_session=True)
     logf.close()
     return proc
 
@@ -776,18 +783,25 @@ def run_hub_chaos(base_dir: str, n_inputs: int = 32,
 
     hub_dir = os.path.join(base_dir, "hub")
     hub_port = free_port()
-    say("spawning hub + 2 managers")
+    hub_http = free_port()
+    say("spawning hub + 2 managers (console-scrapable)")
     t0 = time.monotonic()
-    hub_proc = spawn_hub(hub_dir, hub_port)
+    # a tight sync-age SLO so the console/autopilot flag a dead peer
+    # within the chaos budget
+    sync_slo = 3.0
+    hub_proc = spawn_hub(hub_dir, hub_port, http_port=hub_http,
+                         sync_age=sync_slo)
     out: dict = {}
     procs: dict = {}
     try:
         wait_hub(hub_port)
         ports = {"A": free_port(), "B": free_port()}
+        mgr_http = {"A": free_port(), "B": free_port()}
         dirs = {n: os.path.join(base_dir, f"w-{n}") for n in ports}
         for n in ports:
             procs[n] = spawn_manager(
                 dirs[n], ports[n], name=f"chaos-{n}",
+                http=f"127.0.0.1:{mgr_http[n]}",
                 hub_addr=f"127.0.0.1:{hub_port}", hub_key="chaos",
                 hub_sync_interval=0.5)
         drivers = {}
@@ -834,6 +848,19 @@ def run_hub_chaos(base_dir: str, n_inputs: int = 32,
                        for inp in part_a + part_b}
         out["converge_seconds"] = round(
             converge(("A", "B"), first_union, "initial"), 3)
+
+        # the fleet console watches the whole exchange through the same
+        # HTTP seams the autopilot scrapes; a baseline scrape before the
+        # kill gives the crash-only freeze something to freeze
+        from syzkaller_tpu.observe import FleetConsole
+        console = FleetConsole(
+            [(f"chaos-{n}", f"http://127.0.0.1:{mgr_http[n]}")
+             for n in sorted(ports)],
+            hub_url=f"http://127.0.0.1:{hub_http}",
+            sync_age_threshold=sync_slo, timeout=10.0)
+        console.scrape()
+        pre_b = dict(console._state["chaos-B"])
+        assert not pre_b.get("host_down"), f"B down pre-kill: {pre_b}"
         say(f"converged in {out['converge_seconds']}s; killing B")
 
         sigkill(procs["B"])
@@ -843,10 +870,54 @@ def run_hub_chaos(base_dir: str, n_inputs: int = 32,
         out["survivor_kept_fuzzing"] = True
         time.sleep(1.0)          # a sync interval passes peerless
 
+        # console: the dead peer flips to host_down with its last-seen
+        # series FROZEN (crash-only console — history kept, not lost)
+        console.scrape()
+        st_b = console._state["chaos-B"]
+        assert st_b.get("host_down") and st_b.get("frozen"), \
+            f"console missed the dead peer: {st_b}"
+        assert st_b.get("tsdb_tick") == pre_b.get("tsdb_tick") \
+            and st_b.get("spark") == pre_b.get("spark"), \
+            "frozen series diverged from the last good scrape"
+        out["console_host_down"] = True
+        out["console_series_frozen"] = True
+
+        # console SLO flag must MATCH the autopilot's own verdict: wait
+        # for the hub's sync-age gauge for B to cross the SLO, then
+        # compare the console's hub flags against an independent
+        # HubWatch over the same /metrics endpoint
+        say("waiting for the sync-age SLO to fire for B")
+        stall_deadline = time.monotonic() + 30.0
+        stalled = []
+        while time.monotonic() < stall_deadline:
+            fleet = console.scrape()
+            stalled = [f for f in fleet["flags"]
+                       if f.get("issue") == "hub_sync_stalled"
+                       and 'chaos-B' in str(f.get("series", ""))]
+            if stalled:
+                break
+            time.sleep(0.5)
+        assert stalled, "console never flagged B's sync stall"
+        from syzkaller_tpu.autopilot.controller import HttpSource
+        from syzkaller_tpu.mesh.fleet import SYNC_STALLED, HubWatch
+        verdict = HubWatch(
+            HttpSource(f"http://127.0.0.1:{hub_http}/metrics",
+                       timeout=10.0),
+            sync_age_threshold=sync_slo).check()
+        agrees = [f for f in verdict["flags"]
+                  if f["issue"] == SYNC_STALLED
+                  and 'chaos-B' in str(f.get("series", ""))]
+        assert agrees, f"autopilot verdict disagrees: {verdict}"
+        out["console_slo_flag"] = stalled[0]["issue"]
+        out["console_slo_matches_autopilot"] = True
+        # the console HTML renders from the same state (smoke only)
+        assert "chaos-B" in console.render_html()
+
         say("restarting B (crash-only restore + sketch resync)")
         t_restart = time.monotonic()
         procs["B"] = spawn_manager(
             dirs["B"], ports["B"], name="chaos-B",
+            http=f"127.0.0.1:{mgr_http['B']}",
             hub_addr=f"127.0.0.1:{hub_port}", hub_key="chaos",
             hub_sync_interval=0.5)
         wait_rpc(ports["B"])
@@ -869,6 +940,24 @@ def run_hub_chaos(base_dir: str, n_inputs: int = 32,
             len(union_sigs - sigs[n]) for n in sigs)
         assert out["exchange_false_negatives"] == 0, \
             f"exchange FN: {out}"
+
+        # cross-host lineage: the tail programs were admitted on A
+        # (origin spans live in A's tracer) and pulled by the restarted
+        # B, whose pull-time spans LINK A's trace ids across the hub —
+        # the console must stitch at least one such chain
+        say("checking cross-host trace lineage on the console")
+        lineage_deadline = time.monotonic() + 30.0
+        lineage = []
+        while time.monotonic() < lineage_deadline:
+            fleet = console.scrape()
+            lineage = [ln for ln in fleet["lineage"]
+                       if ln["origin_host"] != ln["host"]]
+            if lineage:
+                break
+            time.sleep(0.5)
+        assert lineage, "console stitched no cross-host span chain"
+        out["console_lineage"] = len(lineage)
+        assert "cross-host lineage" in console.render_html()
 
         # the sketch withheld real traffic: read the hub's persisted
         # per-manager meta restart-style (each manager's own pushes are

@@ -130,6 +130,15 @@ def collect_snapshot(manager) -> bytes:
         tri_entries, tri_feats = mgr.crash_index.export_state()
         fronts = {tag: v.export_blocks()
                   for tag, v in mgr.engine.frontier_views().items()}
+        # the observatory's time-series rings ride the same snapshot
+        # (one transfer of the (S, W) matrix under the gate), so
+        # retained history survives a crash-only restart
+        tsdb_meta, tsdb_arrays = (None, {})
+        if getattr(mgr, "tsdb", None) is not None:
+            try:
+                tsdb_meta, tsdb_arrays = mgr.tsdb.export_state()
+            except Exception:
+                tsdb_meta, tsdb_arrays = None, {}
 
     arrays = {
         "prios": np.asarray(est["prios"], np.float32),
@@ -178,6 +187,9 @@ def collect_snapshot(manager) -> bytes:
         "frontier_tags": ftags,
         "shard_layout": shard_layout,
     }
+    if tsdb_meta is not None:
+        meta["tsdb"] = tsdb_meta
+        arrays.update(tsdb_arrays)
     return encode_snapshot(meta, arrays)
 
 

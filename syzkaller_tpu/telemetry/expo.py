@@ -13,6 +13,7 @@ from __future__ import annotations
 import json
 import math
 import os
+import re
 import time
 
 from syzkaller_tpu.telemetry.device import (
@@ -153,6 +154,174 @@ def parse_prometheus_text(text: str) -> dict:
             except ValueError:
                 continue
     return out
+
+
+# the exact Content-Type every /metrics endpoint must send (Prometheus
+# text exposition 0.0.4) — manager/html.py and hub/http.py both use it,
+# and the conformance tests assert it
+CONTENT_TYPE = "text/plain; version=0.0.4; charset=utf-8"
+
+_NAME_RE = re.compile(r"[a-zA-Z_:][a-zA-Z0-9_:]*$")
+_LABEL_NAME_RE = re.compile(r"[a-zA-Z_][a-zA-Z0-9_]*$")
+
+
+def _parse_labels(s: str, line: str) -> dict:
+    """`k="v",k2="v2"` (the inside of the braces) -> dict, honoring
+    the \\" \\\\ \\n escapes; raises ValueError on any syntax error."""
+    labels: dict[str, str] = {}
+    i, n = 0, len(s)
+    while i < n:
+        j = i
+        while j < n and s[j] not in "=,":
+            j += 1
+        name = s[i:j].strip()
+        if j >= n or s[j] != "=" or not _LABEL_NAME_RE.fullmatch(name):
+            raise ValueError(f"bad label syntax in: {line}")
+        j += 1
+        if j >= n or s[j] != '"':
+            raise ValueError(f"unquoted label value in: {line}")
+        j += 1
+        val = []
+        while j < n and s[j] != '"':
+            if s[j] == "\\":
+                j += 1
+                if j >= n:
+                    raise ValueError(f"dangling escape in: {line}")
+                val.append({"n": "\n", "\\": "\\", '"': '"'}.get(
+                    s[j], "\\" + s[j]))
+            else:
+                val.append(s[j])
+            j += 1
+        if j >= n:
+            raise ValueError(f"unterminated label value in: {line}")
+        if name in labels:
+            raise ValueError(f"duplicate label {name!r} in: {line}")
+        labels[name] = "".join(val)
+        j += 1
+        if j < n:
+            if s[j] != ",":
+                raise ValueError(f"bad label separator in: {line}")
+            j += 1
+        i = j
+    return labels
+
+
+def _parse_value(tok: str, line: str) -> float:
+    if tok == "+Inf":
+        return math.inf
+    if tok == "-Inf":
+        return -math.inf
+    try:
+        return float(tok)
+    except ValueError:
+        raise ValueError(f"bad sample value in: {line}") from None
+
+
+def parse_prometheus_text_strict(text: str) -> dict:
+    """Conformance parser for the 0.0.4 text format; raises ValueError
+    on any violation instead of skipping lines.  Enforced rules:
+
+      * metric and label names match the exposition grammar;
+      * every sample belongs to a family with a PRECEDING `# TYPE`
+        (histogram `_bucket`/`_sum`/`_count` suffixes resolve to their
+        base family);
+      * at most one HELP and one TYPE per family;
+      * no duplicate series (same name + label set twice);
+      * histograms are complete and cumulative: bucket counts
+        non-decreasing in `le` order, an `+Inf` bucket present and
+        equal to `_count`, `_sum`/`_count` present.
+
+    Returns {family: {"type", "help", "samples": {"name{labels}":
+    float}}} — the same line keys parse_prometheus_text produces, so
+    tests can round-trip every exported family through both parsers."""
+    families: dict[str, dict] = {}
+    hist_parts: dict[str, dict] = {}   # family -> group -> parts
+    for raw in text.splitlines():
+        line = raw.rstrip()
+        if not line:
+            continue
+        if line.startswith("#"):
+            parts = line.split(None, 3)
+            if len(parts) < 3 or parts[1] not in ("HELP", "TYPE"):
+                continue            # spec: other comments are ignored
+            kind, name = parts[1], parts[2]
+            if not _NAME_RE.fullmatch(name):
+                raise ValueError(f"bad metric name in: {line}")
+            fam = families.setdefault(
+                name, {"type": "", "help": "", "samples": {}})
+            if fam["samples"]:
+                raise ValueError(
+                    f"# {kind} {name} after its samples")
+            text_rest = parts[3] if len(parts) > 3 else ""
+            if kind == "HELP":
+                if fam["help"]:
+                    raise ValueError(f"duplicate HELP for {name}")
+                fam["help"] = text_rest
+            else:
+                if fam["type"]:
+                    raise ValueError(f"duplicate TYPE for {name}")
+                if text_rest not in ("counter", "gauge", "histogram",
+                                     "summary", "untyped"):
+                    raise ValueError(f"bad TYPE in: {line}")
+                fam["type"] = text_rest
+            continue
+        # sample line: name[{labels}] value [timestamp]
+        m = re.match(r"([a-zA-Z_:][a-zA-Z0-9_:]*)(\{(.*)\})?\s+(\S+)"
+                     r"(\s+-?\d+)?$", line)
+        if m is None:
+            raise ValueError(f"unparseable sample line: {line}")
+        name, _, inner, valtok = m.group(1), m.group(2), m.group(3), \
+            m.group(4)
+        labels = _parse_labels(inner, line) if inner else {}
+        value = _parse_value(valtok, line)
+        base, suffix = name, ""
+        for suf in ("_bucket", "_sum", "_count"):
+            stem = name[:-len(suf)]
+            if name.endswith(suf) and stem in families \
+                    and families[stem]["type"] == "histogram":
+                base, suffix = stem, suf
+                break
+        fam = families.get(base)
+        if fam is None or not fam["type"]:
+            raise ValueError(f"sample without preceding # TYPE: {line}")
+        if fam["type"] == "histogram" and not suffix:
+            raise ValueError(f"bare histogram sample: {line}")
+        key = name + _fmt_labels(labels)
+        if key in fam["samples"]:
+            raise ValueError(f"duplicate series: {key}")
+        fam["samples"][key] = value
+        if suffix:
+            group_labels = {k: v for k, v in labels.items() if k != "le"}
+            gkey = _fmt_labels(group_labels)
+            g = hist_parts.setdefault(base, {}).setdefault(
+                gkey, {"buckets": [], "sum": None, "count": None})
+            if suffix == "_bucket":
+                if "le" not in labels:
+                    raise ValueError(f"_bucket without le: {line}")
+                g["buckets"].append((_parse_value(labels["le"], line),
+                                     value))
+            elif suffix == "_sum":
+                g["sum"] = value
+            else:
+                g["count"] = value
+    for base, groups in hist_parts.items():
+        for gkey, g in groups.items():
+            where = f"{base}{gkey}"
+            if g["sum"] is None or g["count"] is None:
+                raise ValueError(f"histogram {where} missing _sum/_count")
+            buckets = sorted(g["buckets"])
+            if not buckets or not math.isinf(buckets[-1][0]):
+                raise ValueError(f"histogram {where} missing +Inf bucket")
+            last = -math.inf
+            for _le, cum in buckets:
+                if cum < last:
+                    raise ValueError(
+                        f"histogram {where} buckets not cumulative")
+                last = cum
+            if buckets[-1][1] != g["count"]:
+                raise ValueError(
+                    f"histogram {where}: +Inf bucket != _count")
+    return families
 
 
 def persist_snapshot(workdir: str, snap: dict,

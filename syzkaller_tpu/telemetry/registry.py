@@ -197,12 +197,28 @@ class EwmaRate:
         return self.rate()
 
 
+# Per-family label-set bound: the cap on distinct children a Family
+# creates.  Unbounded label values (a crash title, a VM name recycled
+# per boot) would otherwise grow exposition and scrape cost without
+# limit; beyond the cap the write lands in a shared overflow sink (so
+# callers never break) and the drop is counted in
+# syz_telemetry_dropped_labels_total.
+MAX_LABEL_CHILDREN = 256
+
+
 class Family:
     """A labeled metric family: `labels(vm="vm0")` returns the child
-    series, created on first use.  Children share the family lock."""
+    series, created on first use.  Children share the family lock.
+
+    Cardinality guard: at most `max_children` distinct label sets are
+    materialized; further label sets share one unexported overflow
+    child (writes are absorbed, never exposed) and bump the registry's
+    dropped-labels counter via `on_drop`."""
 
     def __init__(self, name: str, cls, labelnames: "tuple[str, ...]",
-                 lock: threading.Lock, **kwargs):
+                 lock: threading.Lock,
+                 max_children: int = MAX_LABEL_CHILDREN,
+                 on_drop: "Callable[[], None] | None" = None, **kwargs):
         self.name = name
         self.cls = cls
         self.kind = cls.kind
@@ -210,19 +226,38 @@ class Family:
         self._lock = lock
         self._kwargs = kwargs
         self._children: dict[tuple, object] = {}
+        self.max_children = int(max_children)
+        self._on_drop = on_drop
+        self._overflow = None
+        self.dropped = 0
 
     def labels(self, **kv):
         if set(kv) != set(self.labelnames):
             raise ValueError(
                 f"{self.name}: labels {sorted(kv)} != {sorted(self.labelnames)}")
         key = _label_key(kv)
+        dropped = False
         with self._lock:
             child = self._children.get(key)
             if child is None:
-                child = self.cls(self.name, labels=kv, lock=self._lock,
-                                 **self._kwargs)
-                self._children[key] = child
-            return child
+                if self.max_children > 0 \
+                        and len(self._children) >= self.max_children:
+                    if self._overflow is None:
+                        self._overflow = self.cls(
+                            self.name, labels={}, lock=self._lock,
+                            **self._kwargs)
+                    child = self._overflow
+                    self.dropped += 1
+                    dropped = True
+                else:
+                    child = self.cls(self.name, labels=kv,
+                                     lock=self._lock, **self._kwargs)
+                    self._children[key] = child
+        # the drop counter has its own lock — increment outside the
+        # family lock to keep lock order trivial
+        if dropped and self._on_drop is not None:
+            self._on_drop()
+        return child
 
     def children(self) -> "list":
         with self._lock:
@@ -233,10 +268,19 @@ class Registry:
     """Owns a component's metric families; collect() yields every live
     series for exposition, snapshot() a JSON-ready dict."""
 
-    def __init__(self):
+    def __init__(self, max_label_children: int = MAX_LABEL_CHILDREN):
         self._lock = threading.Lock()
         self._metrics: dict[str, object] = {}     # name -> metric | Family
         self._help: dict[str, str] = {}
+        self.max_label_children = int(max_label_children)
+        # own lock: Family.labels increments this while OUTSIDE the
+        # family/registry lock, and nesting would deadlock anyway (the
+        # registry lock is not reentrant)
+        self._dropped_labels = Counter(
+            "syz_telemetry_dropped_labels_total", lock=threading.Lock())
+        self._metrics[self._dropped_labels.name] = self._dropped_labels
+        self._help[self._dropped_labels.name] = (
+            "label sets dropped by the per-family cardinality guard")
 
     def _register(self, name: str, help_: str, factory):
         with self._lock:
@@ -248,11 +292,16 @@ class Registry:
             self._help[name] = help_
             return m
 
+    def _family(self, name, cls, labels, **kwargs):
+        return Family(name, cls, labels, self._lock,
+                      max_children=self.max_label_children,
+                      on_drop=self._dropped_labels.inc, **kwargs)
+
     def counter(self, name: str, help: str = "",
                 labels: "tuple[str, ...]" = ()) -> "Counter | Family":
         if labels:
-            return self._register(name, help, lambda: Family(
-                name, Counter, labels, self._lock))
+            return self._register(name, help, lambda: self._family(
+                name, Counter, labels))
         return self._register(name, help, lambda: Counter(name,
                                                           lock=self._lock))
 
@@ -260,8 +309,8 @@ class Registry:
               labels: "tuple[str, ...]" = (),
               fn: "Callable[[], float] | None" = None) -> "Gauge | Family":
         if labels:
-            return self._register(name, help, lambda: Family(
-                name, Gauge, labels, self._lock))
+            return self._register(name, help, lambda: self._family(
+                name, Gauge, labels))
         return self._register(name, help, lambda: Gauge(name,
                                                         lock=self._lock,
                                                         fn=fn))
@@ -270,9 +319,8 @@ class Registry:
                   labels: "tuple[str, ...]" = (), base: float = 1e-6,
                   nbuckets: int = 24) -> "Histogram | Family":
         if labels:
-            return self._register(name, help, lambda: Family(
-                name, Histogram, labels, self._lock, base=base,
-                nbuckets=nbuckets))
+            return self._register(name, help, lambda: self._family(
+                name, Histogram, labels, base=base, nbuckets=nbuckets))
         return self._register(name, help, lambda: Histogram(
             name, lock=self._lock, base=base, nbuckets=nbuckets))
 
@@ -280,8 +328,8 @@ class Registry:
              labels: "tuple[str, ...]" = (),
              tau: float = 60.0) -> "EwmaRate | Family":
         if labels:
-            return self._register(name, help, lambda: Family(
-                name, EwmaRate, labels, self._lock, tau=tau))
+            return self._register(name, help, lambda: self._family(
+                name, EwmaRate, labels, tau=tau))
         return self._register(name, help, lambda: EwmaRate(
             name, lock=self._lock, tau=tau))
 

@@ -1,0 +1,724 @@
+"""Fleet observatory tests: the device-resident time-series store
+(bit-exact vs the host shadow, zero warm recompiles, snapshot
+survival), dispatch-level profiling, the syz_slo_* gauges and their
+single verdict function, the label-cardinality guard, strict
+Prometheus text conformance of every exported family, cross-host trace
+stitching across Hub.Sync (including a hub restart), and the fleet
+console's crash-only freeze + lineage waterfall."""
+
+import json
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from syzkaller_tpu import rpc, telemetry
+from syzkaller_tpu.observe import (DISPATCH_ATTRS, DeviceTsdb,
+                                   DispatchProfiler, FleetConsole,
+                                   HostClient, HostTsdb, TIERS,
+                                   register_slo_gauges, window_width)
+from syzkaller_tpu.observe.tsdb import _SLOT
+from syzkaller_tpu.telemetry import expo
+from syzkaller_tpu.vet.runtime import CompileCounter
+
+
+# -- device tsdb ------------------------------------------------------------
+
+
+def _drive(stores, cum):
+    """Feed one cumulative vector snapshot to a mixed list of
+    device/host stores (the device store reads ds.vec; callers set it
+    first)."""
+    for st in stores:
+        if isinstance(st, HostTsdb):
+            st.sample(cum)
+        else:
+            st.sample_now()
+
+
+def test_tsdb_bit_exact_vs_host_shadow():
+    """700 ticks with a mid-run counter reset: the device ring must
+    equal the numpy shadow bit-for-bit across all three tiers."""
+    import jax.numpy as jnp
+
+    ds = telemetry.DeviceStats()
+    dev = DeviceTsdb([ds])
+    host = HostTsdb(ds.nslots)
+    rng = np.random.default_rng(7)
+    cum = np.zeros(ds.nslots, np.int64)
+    for t in range(700):
+        if t == 350:
+            cum[:] = 0          # flush(reset=True) mid-run: re-base arm
+        cum[:8] += rng.integers(0, 5, size=8)
+        # hand the device a COPY: jnp.asarray may alias the numpy
+        # buffer on CPU, and cum mutates under the async dispatch
+        ds.vec = jnp.asarray(cum.astype(np.int32, copy=True))
+        _drive([dev, host], cum.astype(np.int32))
+    got = dev.scrape()
+    assert got.shape == (ds.nslots, window_width())
+    assert np.array_equal(got, host.ring)
+    assert dev.tick == host.tick == 700
+    # every tier holds signal (700 ticks = 46 tier-1 folds, 2 tier-2)
+    for tier, (_sec, _cols) in enumerate(TIERS):
+        assert dev.window("dense_batches", tier).sum() > 0
+
+
+def test_tsdb_zero_warm_recompiles():
+    """After the first sample compiles the rollup kernel, hundreds of
+    ticks spanning 15s and 300s fold boundaries recompile NOTHING —
+    the tick operands are traced, not baked into the jaxpr."""
+    import jax.numpy as jnp
+
+    ds = telemetry.DeviceStats()
+    dev = DeviceTsdb([ds])
+    dev.sample_now()            # builds + compiles the kernel
+    vec = np.zeros(ds.nslots, np.int32)
+    with CompileCounter() as cc:
+        for _t in range(330):   # crosses t%15==14 and t%300==299
+            vec[0] += 1
+            ds.vec = jnp.asarray(vec.copy())
+            dev.sample_now()
+    assert cc.count == 0, f"warm recompiles: {cc.events}"
+    assert dev.tick == 331 and dev.errors == 0
+
+
+def test_tsdb_windows_rates_stall():
+    import jax.numpy as jnp
+
+    ds = telemetry.DeviceStats()
+    dev = DeviceTsdb([ds])
+    slot = _SLOT["admit_admitted"]
+    cum = np.zeros(ds.nslots, np.int32)
+    for t in range(30):
+        if t < 20:
+            cum[slot] += 2      # 2 admissions/s for 20s, then silence
+        ds.vec = jnp.asarray(cum.copy())
+        dev.sample_now()
+    w = dev.window("admit_admitted", tier=0)
+    assert len(w) == 30
+    assert w[:20].sum() == 40 and w[20:].sum() == 0
+    # last 15 columns hold 5 live seconds of rate 2
+    assert dev.window_rate("admit_admitted", seconds=15.0) \
+        == pytest.approx(10 / 15.0)
+    assert dev.stall_seconds("admit_admitted") == pytest.approx(10.0)
+    # a slot that never moved stalls for the whole uptime, clamped
+    assert dev.stall_seconds("triage_reports") == pytest.approx(30.0)
+    snap = dev.snapshot_json(keys=["admit_admitted"])
+    assert snap["tick"] == 30
+    assert snap["tiers"][0]["series"]["admit_admitted"] == [int(x)
+                                                            for x in w]
+
+
+def test_tsdb_maybe_sample_interval_gate():
+    ds = telemetry.DeviceStats()
+    dev = DeviceTsdb([ds], interval=1.0)
+    assert dev.maybe_sample(now=100.0)
+    assert not dev.maybe_sample(now=100.5)      # inside the interval
+    assert dev.maybe_sample(now=101.01)
+    assert dev.samples == 2
+
+
+def test_tsdb_export_import_roundtrip():
+    import jax.numpy as jnp
+
+    ds = telemetry.DeviceStats()
+    a = DeviceTsdb([ds])
+    cum = np.zeros(ds.nslots, np.int32)
+    for _t in range(40):
+        cum[1] += 3
+        ds.vec = jnp.asarray(cum.copy())
+        a.sample_now()
+    meta, arrays = a.export_state()
+    assert set(arrays) == {"tsdb_ring", "tsdb_last", "tsdb_acc15",
+                           "tsdb_acc300"}
+    ds2 = telemetry.DeviceStats()
+    b = DeviceTsdb([ds2])
+    b.import_state(meta, arrays)
+    assert b.tick == 40
+    assert np.array_equal(a.scrape(), b.scrape())
+    # both resume in lockstep: accumulators carried over exactly
+    for _t in range(20):
+        cum[1] += 1
+        v = jnp.asarray(cum.copy())
+        ds.vec = v
+        ds2.vec = v
+        a.sample_now()
+        b.sample_now()
+    assert np.array_equal(a.scrape(), b.scrape())
+    # a layout-mismatched snapshot is skipped, never bricks the restore
+    c = DeviceTsdb([telemetry.DeviceStats()])
+    c.import_state({"tick": 9}, {"tsdb_ring": np.zeros((2, 2), np.int32)})
+    assert c.tick == 0
+
+
+# -- dispatch profiler ------------------------------------------------------
+
+
+def _small_engine(ds):
+    from syzkaller_tpu.cover.engine import CoverageEngine
+    return CoverageEngine(npcs=1 << 12, ncalls=16, corpus_cap=64,
+                          batch=8, max_pcs_per_exec=32, telemetry=ds)
+
+
+def test_dispatch_profiler_attach_and_counts():
+    reg = telemetry.Registry()
+    prof = DispatchProfiler()
+    prof.register_metrics(reg)
+    eng = _small_engine(telemetry.DeviceStats())
+    names = prof.attach(eng)
+    assert len(names) >= 10
+    # idempotent: a second attach wraps nothing twice
+    again = prof.attach(eng)
+    assert again == names
+    for attr in DISPATCH_ATTRS:
+        fn = getattr(eng, attr, None)
+        if fn is not None:
+            assert getattr(fn.__wrapped__, "_syz_dispatch", None) is None
+    # drive real dispatches through the wrapped closures
+    rng = np.random.default_rng(3)
+    idx = rng.integers(0, 1 << 14, size=(4, 64)).astype(np.int32)
+    eng.update_batch_sparse(np.zeros(4, np.int32), idx,
+                            np.ones((4, 64), bool))
+    snap = prof.snapshot()
+    total = sum(d["count"] for d in snap["dispatches"].values())
+    assert total > 0
+    assert len(snap["upper_bounds"]) == 24
+    assert snap["upper_bounds"][-1] == "+Inf"
+    for d in snap["dispatches"].values():
+        assert sum(d["buckets"]) == d["count"]
+    # the gauge families expose the same counts per dispatch name
+    text = expo.prometheus_text([reg])
+    series = expo.parse_prometheus_text(text)
+    called = [n for n, d in snap["dispatches"].items() if d["count"]]
+    assert called
+    for n in called:
+        key = 'syz_dispatch_calls{dispatch="%s"}' % n
+        assert series[key] == snap["dispatches"][n]["count"]
+
+
+def test_dispatch_profiler_recompile_attribution():
+    prof = DispatchProfiler()
+    # a compile event landing while a wrapped dispatch runs is charged
+    # to that dispatch; outside any dispatch it lands in "other"
+    wrapped = prof.wrap("probe", lambda: prof._on_compile())
+    wrapped()
+    prof._on_compile()
+    snap = prof.snapshot()
+    assert snap["recompiles"]["probe"] == 1
+    assert snap["recompiles"].get("other", 0) >= 1
+    assert snap["dispatches"]["probe"]["count"] == 1
+    # wrapper passes values and exceptions straight through
+    assert prof.wrap("v", lambda x: x + 1)(41) == 42
+    with pytest.raises(ValueError):
+        prof.wrap("e", _raise)()
+    assert prof.snapshot()["dispatches"]["e"]["count"] == 1
+
+
+def _raise():
+    raise ValueError("boom")
+
+
+# -- slo verdicts -----------------------------------------------------------
+
+
+def test_slo_flags_single_verdict_function():
+    from syzkaller_tpu.mesh.fleet import (COVERAGE_STALLED, RING_FULL,
+                                          SYNC_STALLED, slo_flags)
+
+    assert slo_flags({}) == []
+    assert slo_flags({"syz_slo_coverage_stall_seconds": 301.0}) \
+        == [COVERAGE_STALLED]
+    assert slo_flags({"syz_slo_hub_sync_stall_seconds": 400.0}) \
+        == [SYNC_STALLED]
+    assert slo_flags({"syz_slo_ingest_ring_full_rate": 1.5}) \
+        == [RING_FULL]
+    assert slo_flags({"syz_slo_coverage_stall_seconds": 301.0,
+                      "syz_slo_hub_sync_stall_seconds": 400.0,
+                      "syz_slo_ingest_ring_full_rate": 1.5}) \
+        == [COVERAGE_STALLED, SYNC_STALLED, RING_FULL]
+    # thresholds are parameters, not constants
+    assert slo_flags({"syz_slo_coverage_stall_seconds": 10.0},
+                     coverage_stall=5.0) == [COVERAGE_STALLED]
+    assert slo_flags({"syz_slo_hub_sync_stall_seconds": 400.0},
+                     sync_stall=0) == []
+
+
+def test_register_slo_gauges_degrade_without_planes():
+    class _Cfg:
+        hub_addr = ""
+
+    class _Shed:
+        value = 0
+
+    class _Mgr:
+        cfg = _Cfg()
+        tsdb = None
+        _c_shed = _Shed()
+
+    reg = telemetry.Registry()
+    register_slo_gauges(reg, _Mgr())
+    snap = reg.snapshot()
+    for name in ("syz_slo_coverage_stall_seconds",
+                 "syz_slo_ingest_ring_full_rate", "syz_slo_shed_rate",
+                 "syz_slo_hub_sync_stall_seconds"):
+        assert snap[name] == 0.0
+
+
+# -- label-cardinality guard ------------------------------------------------
+
+
+def test_registry_label_cardinality_guard():
+    reg = telemetry.Registry(max_label_children=4)
+    fam = reg.counter("syz_guard_total", "guarded", labels=("k",))
+    for i in range(10):
+        fam.labels(k=f"v{i}").inc()
+    assert len(fam._children) == 4
+    assert fam.dropped == 6
+    snap = reg.snapshot()
+    assert snap["syz_telemetry_dropped_labels_total"] == 6
+    # the overflow sink absorbed the excess writes but is NOT exported
+    assert fam._overflow is not None and fam._overflow.value == 6
+    assert len(snap["syz_guard_total"]) == 4
+    text = expo.prometheus_text([reg])
+    assert text.count("syz_guard_total{") == 4
+    # existing children keep working at the cap
+    fam.labels(k="v0").inc(5)
+    assert fam.labels(k="v0").value == 6
+    assert fam.dropped == 6
+    # the strict parser accepts the guarded exposition wholesale
+    strict = expo.parse_prometheus_text_strict(expo.prometheus_text([reg]))
+    assert len(strict["syz_guard_total"]["samples"]) == 4
+
+
+# -- strict exposition conformance ------------------------------------------
+
+
+def test_strict_parser_accepts_own_exposition():
+    reg = telemetry.Registry()
+    reg.counter("syz_a_total", "a counter").inc(3)
+    reg.gauge("syz_b", "a gauge", fn=lambda: 2.5)
+    fam = reg.counter("syz_c_total", "labeled", labels=("vm", "kind"))
+    fam.labels(vm='q"uo\\te', kind="x\ny").inc(2)
+    h = reg.histogram("syz_d_seconds", "a histogram")
+    h.observe(0.001)
+    h.observe(1e9)
+    text = expo.prometheus_text([reg])
+    fams = expo.parse_prometheus_text_strict(text)
+    loose = expo.parse_prometheus_text(text)
+    assert fams["syz_a_total"]["type"] == "counter"
+    assert fams["syz_d_seconds"]["type"] == "histogram"
+    # every loose-parsed series appears under exactly one strict family
+    nsamples = sum(len(f["samples"]) for f in fams.values())
+    assert nsamples == len(loose)
+    lab = [k for k in fams["syz_c_total"]["samples"] if "uo" in k]
+    assert len(lab) == 1 and fams["syz_c_total"]["samples"][lab[0]] == 2
+
+
+@pytest.mark.parametrize("bad", [
+    "syz_x_total 1\n",                                  # samples sans TYPE
+    "# TYPE syz_x_total counter\nsyz_x_total 1\nsyz_x_total 2\n",
+    "# TYPE syz_x counter\n# TYPE syz_x counter\nsyz_x 1\n",
+    "# TYPE 9bad counter\n9bad 1\n",                    # bad name grammar
+    "# TYPE syz_x counter\nsyz_x notafloat\n",
+    "# TYPE syz_x counter\nsyz_x{k=\"a\",k=\"b\"} 1\n",  # dup label
+    # histogram: buckets must be cumulative and end at +Inf == _count
+    ("# TYPE syz_h histogram\n"
+     'syz_h_bucket{le="1"} 5\nsyz_h_bucket{le="+Inf"} 3\n'
+     "syz_h_sum 1\nsyz_h_count 3\n"),
+    ("# TYPE syz_h histogram\n"
+     'syz_h_bucket{le="1"} 1\nsyz_h_sum 1\nsyz_h_count 1\n'),
+    ("# TYPE syz_h histogram\n"
+     'syz_h_bucket{le="+Inf"} 2\nsyz_h_sum 1\nsyz_h_count 1\n'),
+])
+def test_strict_parser_rejects(bad):
+    with pytest.raises(ValueError):
+        expo.parse_prometheus_text_strict(bad)
+
+
+@pytest.fixture
+def live_manager(tmp_path):
+    from syzkaller_tpu.manager.config import Config
+    from syzkaller_tpu.manager.manager import Manager
+
+    cfg = Config(name="obs", workdir=str(tmp_path / "m"), type="local",
+                 count=1, descriptions="probe.txt", npcs=1 << 12,
+                 corpus_cap=64, http="")
+    mgr = Manager(cfg)
+    mgr.server.serve_background()
+    yield mgr
+    mgr.stop()
+
+
+def test_manager_metrics_strict_over_http(live_manager):
+    """The real /metrics endpoint: exact content-type and every family
+    round-trips through the strict conformance parser."""
+    from syzkaller_tpu.manager import html
+
+    mgr = live_manager
+    cli = rpc.RpcClient(f"127.0.0.1:{mgr.rpc_port}")
+    try:
+        cli.call("Manager.Connect", {"name": "vmS"})
+        meta = mgr.table.calls[0]
+        cli.call("Manager.NewInput", {
+            "name": "vmS", "prog": rpc.b64(b"s()\n"), "call": meta.name,
+            "call_index": 0, "cover": [0x11, 0x22]})
+    finally:
+        cli.close()
+    mgr.tsdb.sample_now()
+    srv = html.serve(mgr, "127.0.0.1", 0)
+    try:
+        host, port = srv.server_address
+        with urllib.request.urlopen(
+                f"http://{host}:{port}/metrics", timeout=10) as resp:
+            assert resp.status == 200
+            assert resp.headers["Content-Type"] == expo.CONTENT_TYPE
+            text = resp.read().decode()
+        fams = expo.parse_prometheus_text_strict(text)
+        loose = expo.parse_prometheus_text(text)
+        assert sum(len(f["samples"]) for f in fams.values()) == len(loose)
+        for must in ("syz_corpus_size", "syz_slo_coverage_stall_seconds",
+                     "syz_slo_hub_sync_stall_seconds",
+                     "syz_dispatch_calls", "syz_dispatch_recompiles",
+                     "syz_telemetry_dropped_labels_total"):
+            assert must in fams, f"missing family {must}"
+        assert fams["syz_rpc_request_seconds"]["type"] == "histogram"
+        # the new observability endpoints serve JSON
+        with urllib.request.urlopen(
+                f"http://{host}:{port}/tsdb", timeout=10) as resp:
+            assert resp.headers["Content-Type"].startswith(
+                "application/json")
+            tsdb = json.loads(resp.read().decode())
+        assert tsdb["tick"] >= 1
+        assert [t["seconds"] for t in tsdb["tiers"]] == [1, 15, 300]
+        with urllib.request.urlopen(
+                f"http://{host}:{port}/profile/dispatches",
+                timeout=10) as resp:
+            prof = json.loads(resp.read().decode())
+        assert len(prof["dispatches"]) >= 10
+    finally:
+        srv.shutdown()
+
+
+def test_hub_metrics_strict_over_http(tmp_path):
+    from syzkaller_tpu.hub import http as hub_http
+    from syzkaller_tpu.hub.hub import Hub
+
+    hub = Hub(str(tmp_path / "hub"), key="k")
+    hub.serve_background()
+    srv = None
+    try:
+        cli = rpc.RpcClient("%s:%d" % hub.addr)
+        try:
+            cli.call("Hub.Connect", {"name": "mgrS", "key": "k",
+                                     "fresh": True})
+            cli.call("Hub.Sync", {"name": "mgrS", "key": "k",
+                                  "add": [rpc.b64(b"prog-s")]})
+        finally:
+            cli.close()
+        srv = hub_http.serve(hub, "127.0.0.1", 0)
+        host, port = srv.server_address
+        with urllib.request.urlopen(
+                f"http://{host}:{port}/metrics", timeout=10) as resp:
+            assert resp.headers["Content-Type"] == expo.CONTENT_TYPE
+            text = resp.read().decode()
+        fams = expo.parse_prometheus_text_strict(text)
+        loose = expo.parse_prometheus_text(text)
+        assert sum(len(f["samples"]) for f in fams.values()) == len(loose)
+        assert "syz_hub_corpus_size" in fams
+    finally:
+        if srv is not None:
+            srv.shutdown()
+        hub.close()
+
+
+# -- snapshot/restore survival ----------------------------------------------
+
+
+def test_tsdb_survives_checkpoint(live_manager):
+    """The rings ride the PR 9 snapshot blob and restore into a fresh
+    store bit-exactly."""
+    from syzkaller_tpu.resilience import checkpoint
+
+    mgr = live_manager
+    for _ in range(5):
+        mgr.tsdb.sample_now()
+    blob = checkpoint.collect_snapshot(mgr)
+    meta, arrays = checkpoint.decode_snapshot(blob)
+    assert meta["tsdb"]["tick"] == 5
+    assert "tsdb_ring" in arrays
+    st = checkpoint.RestoredState(meta, arrays)
+    fresh = DeviceTsdb([telemetry.DeviceStats()])
+    fresh.import_state(st.meta["tsdb"], st.arrays)
+    assert fresh.tick == 5
+    assert np.array_equal(fresh.scrape(), mgr.tsdb.scrape())
+
+
+# -- cross-host trace stitching ---------------------------------------------
+
+
+def test_hub_sync_trace_wire_roundtrip(tmp_path):
+    """The wire contract: `traces` rides parallel to `add` on the push
+    and parallel to `progs` on the pull, first-pusher-wins, and the
+    origins index survives a hub restart via the sidecar files."""
+    from syzkaller_tpu.hub.hub import Hub
+
+    hubdir = str(tmp_path / "hub")
+    hub = Hub(hubdir, key="k")
+    hub.serve_background()
+    try:
+        cli = rpc.RpcClient("%s:%d" % hub.addr)
+        try:
+            cli.call("Hub.Connect", {"name": "mgrA", "key": "k",
+                                     "fresh": True})
+            cli.call("Hub.Sync", {"name": "mgrA", "key": "k",
+                                  "add": [rpc.b64(b"pa"), rpc.b64(b"pb")],
+                                  "traces": ["t-aaa"]})  # pb has no trace
+            cli.call("Hub.Connect", {"name": "mgrB", "key": "k",
+                                     "fresh": True})
+            r = cli.call("Hub.Sync", {"name": "mgrB", "key": "k",
+                                      "add": []})
+        finally:
+            cli.close()
+        progs = [rpc.unb64(p) for p in r["progs"]]
+        origin = dict(zip(progs, r["traces"]))
+        assert origin[b"pa"] == {"manager": "mgrA", "trace": "t-aaa"}
+        assert origin[b"pb"] == {}
+    finally:
+        hub.close()
+    # restart on the same dir: origins reload from the sidecar
+    hub2 = Hub(hubdir, key="k")
+    hub2.serve_background()
+    try:
+        assert list(hub2.state.origins.values()) \
+            == [{"manager": "mgrA", "trace": "t-aaa"}]
+        cli = rpc.RpcClient("%s:%d" % hub2.addr)
+        try:
+            cli.call("Hub.Connect", {"name": "mgrC", "key": "k",
+                                     "fresh": True})
+            r = cli.call("Hub.Sync", {"name": "mgrC", "key": "k",
+                                      "add": []})
+        finally:
+            cli.close()
+        origin = dict(zip([rpc.unb64(p) for p in r["progs"]],
+                          r["traces"]))
+        assert origin[b"pa"] == {"manager": "mgrA", "trace": "t-aaa"}
+    finally:
+        hub2.close()
+
+
+def test_trace_links_survive_hub_exchange(tmp_path):
+    """End-to-end stitching: an input admitted on manager A ships
+    A -> hub -> B; B's pull-time span AND its local re-admission span
+    both link A's admitting trace id, and the fleet console stitches
+    the two hosts into one lineage chain."""
+    from syzkaller_tpu.hub.hub import Hub
+    from syzkaller_tpu.manager.config import Config
+    from syzkaller_tpu.manager.manager import Manager
+
+    hub = Hub(str(tmp_path / "hub"), key="k")
+    hub.serve_background()
+    mgrs = {}
+    try:
+        for n in ("obsA", "obsB"):
+            cfg = Config(name=n, workdir=str(tmp_path / n), type="local",
+                         count=1, descriptions="probe.txt", npcs=1 << 12,
+                         corpus_cap=64, http="",
+                         hub_addr="%s:%d" % hub.addr, hub_key="k")
+            mgrs[n] = Manager(cfg)
+            mgrs[n].server.serve_background()
+        a, b = mgrs["obsA"], mgrs["obsB"]
+        # admit on A with a fuzzer-side span
+        cli = rpc.RpcClient(f"127.0.0.1:{a.rpc_port}")
+        span = telemetry.SpanContext(origin="vmA")
+        try:
+            cli.call("Manager.Connect", {"name": "vmA"})
+            meta = a.table.calls[0]
+            # the hub's call-set filter parses the program text, so the
+            # pushed body must use an enabled call name
+            prog = f"{meta.name}()\n".encode()
+            cli.call("Manager.NewInput", {
+                "name": "vmA", "prog": rpc.b64(prog),
+                "call": meta.name, "call_index": 0,
+                "cover": [0x100, 0x200]}, span=span)
+        finally:
+            cli.close()
+        assert len(a.corpus) == 1
+        item = next(iter(a.corpus.values()))
+        assert item.trace_id == span.trace_id
+        a.hub_sync_once()       # push (with the trace id beside it)
+        b.hub_sync_once()       # pull: origin captured + lineage span
+        assert b.candidates and b.candidates[0] == prog
+        pulls = [t for t in b.tracer.snapshot()
+                 if span.trace_id in t.get("links", [])]
+        assert pulls, "pull-time lineage span missing"
+        assert any("shipped from obsA" in h["name"]
+                   for h in pulls[0]["hops"])
+        # the fuzzer replays the candidate; the admission span links
+        # the origin trace (the serial AND coalesced paths share this)
+        cli = rpc.RpcClient(f"127.0.0.1:{b.rpc_port}")
+        bspan = telemetry.SpanContext(origin="vmB")
+        try:
+            cli.call("Manager.Connect", {"name": "vmB"})
+            meta = b.table.calls[0]
+            cli.call("Manager.NewInput", {
+                "name": "vmB", "prog": rpc.b64(prog),
+                "call": meta.name, "call_index": 0,
+                "cover": [0x100, 0x200]}, span=bspan)
+        finally:
+            cli.close()
+        admitted = {t["trace_id"]: t for t in b.tracer.snapshot()}
+        assert span.trace_id in admitted[bspan.trace_id]["links"]
+        assert any("hub:from obsA" in h["name"]
+                   for h in admitted[bspan.trace_id]["hops"])
+        # console stitch over the REAL trace windows of both managers
+        def fetch(url, _m=mgrs):
+            name = "obsA" if "//a" in url else "obsB"
+            m = _m[name]
+            if url.endswith("/metrics"):
+                return expo.prometheus_text([m.registry]).encode()
+            if url.endswith("/telemetry"):
+                return json.dumps(m.telemetry_snapshot()).encode()
+            if url.endswith("/healthz"):
+                return b'{"status": "ok"}'
+            return b"{}"
+        console = FleetConsole([("obsA", "http://a"), ("obsB", "http://b")],
+                               fetch=fetch)
+        fleet = console.scrape()
+        chains = [ln for ln in fleet["lineage"]
+                  if ln["origin_host"] == "obsA" and ln["host"] == "obsB"
+                  and ln["origin_trace"] == span.trace_id]
+        assert chains, fleet["lineage"]
+        html = console.render_html()
+        assert "cross-host lineage" in html and span.trace_id in html
+    finally:
+        for m in mgrs.values():
+            m.stop()
+        hub.close()
+
+
+# -- fleet console ----------------------------------------------------------
+
+
+def _canned_fleet():
+    """url -> body for an injected-fetch console: two managers and a
+    hub, manager B stalled on coverage, hub reporting B's sync stale."""
+    mgr_a = ("# TYPE syz_corpus_size gauge\nsyz_corpus_size 5\n"
+             "# TYPE syz_exec_rate gauge\nsyz_exec_rate 12.5\n"
+             "# TYPE syz_slo_coverage_stall_seconds gauge\n"
+             "syz_slo_coverage_stall_seconds 10\n")
+    mgr_b = ("# TYPE syz_corpus_size gauge\nsyz_corpus_size 2\n"
+             "# TYPE syz_slo_coverage_stall_seconds gauge\n"
+             "syz_slo_coverage_stall_seconds 400\n")
+    hub = ("# TYPE syz_hub_corpus_size gauge\nsyz_hub_corpus_size 7\n"
+           "# TYPE syz_hub_managers gauge\nsyz_hub_managers 2\n"
+           "# TYPE syz_hub_sync_age_seconds gauge\n"
+           'syz_hub_sync_age_seconds{manager="A"} 12\n'
+           'syz_hub_sync_age_seconds{manager="B"} 9000\n')
+    telem_a = {"traces": [{"trace_id": "tA", "origin": "vmA",
+                           "hops": [{"name": "manager:admit",
+                                     "dur_us": 120}]}]}
+    telem_b = {"traces": [{"trace_id": "tB", "origin": "obsB",
+                           "links": ["tA"],
+                           "hops": [{"name": "hub:from obsA",
+                                     "dur_us": 0}]}]}
+    tsdb_a = {"tick": 3, "tiers": [
+        {"seconds": 1, "columns": 64,
+         "series": {"admit_admitted": [1, 0, 2]}},
+        {"seconds": 15, "columns": 60, "series": {}}]}
+    return {
+        "http://a/metrics": mgr_a.encode(),
+        "http://a/telemetry": json.dumps(telem_a).encode(),
+        "http://a/healthz": b'{"status": "ok"}',
+        "http://a/tsdb": json.dumps(tsdb_a).encode(),
+        "http://b/metrics": mgr_b.encode(),
+        "http://b/telemetry": json.dumps(telem_b).encode(),
+        "http://b/healthz": b'{"status": "degraded"}',
+        "http://b/tsdb": b"{}",
+        "http://hub/metrics": hub.encode(),
+        "http://hub/healthz": b'{"status": "ok"}',
+    }
+
+
+def test_console_aggregation_slo_and_hub_flags():
+    bodies = _canned_fleet()
+    console = FleetConsole([("A", "http://a"), ("B", "http://b")],
+                           hub_url="http://hub",
+                           fetch=lambda u: bodies[u])
+    fleet = console.scrape()
+    a, b = fleet["managers"]["A"], fleet["managers"]["B"]
+    assert a["summary"]["corpus"] == 5 and not a["host_down"]
+    assert a["spark"] == [1, 0, 2] and a["tsdb_tick"] == 3
+    assert a["slo_flags"] == []
+    # B crossed the coverage-stall threshold: same verdict function
+    # the autopilot runs
+    assert b["slo_flags"] == ["coverage_stalled"]
+    assert {"host": "B", "issue": "coverage_stalled"} in fleet["flags"]
+    # the hub watchdog flags B's sync age, not A's
+    hub = fleet["hub"]
+    assert hub["corpus"] == 7
+    assert hub["sync_ages"] == {"A": 12, "B": 9000}
+    hub_flags = [f for f in fleet["flags"] if f.get("host") == "hub"]
+    assert any(f["issue"] == "hub_sync_stalled" and '"B"' in f["series"]
+               for f in hub_flags)
+    assert not any('"A"' in f.get("series", "") for f in hub_flags)
+    # cross-host lineage stitched from the canned trace windows
+    assert fleet["lineage"] == [{
+        "host": "B", "trace": "tB", "origin_host": "A",
+        "origin_trace": "tA",
+        "hops": [{"name": "hub:from obsA", "dur_us": 0}],
+        "origin_hops": [{"name": "manager:admit", "dur_us": 120}]}]
+    html = console.render_html()
+    for needle in ("fleet console", "coverage_stalled", "tA", "tB",
+                   "polyline"):
+        assert needle in html
+
+
+def test_console_crash_only_freeze():
+    """A dying host flips to host_down with its series FROZEN from the
+    last good scrape — never blanked."""
+    bodies = _canned_fleet()
+    alive = {"v": True}
+
+    def fetch(url):
+        if "//a" in url and not alive["v"]:
+            raise OSError("connection refused")
+        return bodies[url]
+
+    console = FleetConsole([("A", "http://a")], fetch=fetch)
+    first = console.scrape()
+    pre = first["managers"]["A"]
+    assert not pre["host_down"] and pre["spark"] == [1, 0, 2]
+    alive["v"] = False
+    second = console.scrape()
+    st = second["managers"]["A"]
+    assert st["host_down"] and st["frozen"]
+    assert st["spark"] == pre["spark"]          # frozen, not lost
+    assert st["summary"] == pre["summary"]
+    assert {"host": "A", "issue": "host_down"} in second["flags"]
+    html = console.render_html()
+    assert "HOST_DOWN" in html and "frozen series" in html
+    # a host that was NEVER seen gets an empty (unfrozen) down panel
+    c2 = FleetConsole([("Z", "http://z")], fetch=fetch)
+    z = c2.scrape()["managers"]["Z"]
+    assert z["host_down"] and not z["frozen"] and z["spark"] == []
+
+
+def test_host_client_degraded_healthz_and_missing_tsdb():
+    """/healthz 503 still carries the body; a pre-observatory manager
+    without /tsdb reads as an empty store, not an error."""
+    import io
+
+    def fetch(url):
+        if url.endswith("/healthz"):
+            raise urllib.error.HTTPError(
+                url, 503, "degraded", None,
+                io.BytesIO(b'{"status": "degraded", "reason": "x"}'))
+        if url.endswith("/tsdb"):
+            raise urllib.error.HTTPError(url, 404, "nf", None,
+                                         io.BytesIO(b"not found"))
+        raise AssertionError(url)
+
+    cli = HostClient("h", "http://h", fetch=fetch)
+    assert cli.healthz() == {"status": "degraded", "reason": "x"}
+    assert cli.tsdb() == {}

@@ -320,7 +320,9 @@ def test_metrics_endpoint_over_http(live_manager):
         with urllib.request.urlopen(
                 f"http://{host}:{port}/metrics", timeout=10) as resp:
             assert resp.status == 200
-            assert resp.headers["Content-Type"].startswith("text/plain")
+            # exact exposition content-type (conformance contract;
+            # the strict parser round-trip lives in test_observe.py)
+            assert resp.headers["Content-Type"] == expo.CONTENT_TYPE
             text = resp.read().decode()
         series = expo.parse_prometheus_text(text)
         assert len(series) >= 20

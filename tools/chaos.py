@@ -71,6 +71,15 @@ def main(argv=None) -> int:
             out["hub"] = chaos.run_hub_chaos(
                 os.path.join(base, "hub-fleet"), n_inputs=min(n, 32),
                 verbose=verbose)
+            # fleet-observatory contract: the console saw the killed
+            # manager as host_down with its series FROZEN (not lost),
+            # raised the sync-stall SLO flag the autopilot's own
+            # verdict function agrees with, and stitched at least one
+            # cross-host trace chain for a hub-shipped program
+            assert out["hub"]["console_host_down"], out["hub"]
+            assert out["hub"]["console_series_frozen"], out["hub"]
+            assert out["hub"]["console_slo_matches_autopilot"], out["hub"]
+            assert out["hub"]["console_lineage"] >= 1, out["hub"]
         if not args.no_autopilot:
             # the compound-failure cycle: kill 2 of N VM threads + flap
             # the backend + wedge a campaign, autopilot remediates all

@@ -1,0 +1,109 @@
+#!/usr/bin/env python
+"""Fleet console CLI: one-shot fleet JSON/HTML, or a live server.
+
+    # one fleet snapshot as JSON
+    python tools/console.py --manager A=http://h1:7780 \
+        --manager B=http://h2:7780 --hub http://hub:7789
+
+    # render HTML once
+    python tools/console.py --manager A=http://h1:7780 --html
+
+    # live console (re-scrapes per request)
+    python tools/console.py --manager A=http://h1:7780 \
+        --serve 127.0.0.1:8900
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import threading
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--manager", action="append", default=[],
+                    metavar="NAME=URL",
+                    help="manager scrape target (repeatable)")
+    ap.add_argument("--hub", default="", help="hub HTTP base URL")
+    ap.add_argument("--sync-age", type=float, default=300.0,
+                    help="hub sync-age SLO threshold (seconds)")
+    ap.add_argument("--coverage-stall", type=float, default=300.0,
+                    help="coverage-stall SLO threshold (seconds)")
+    ap.add_argument("--html", action="store_true",
+                    help="print one HTML render instead of JSON")
+    ap.add_argument("--serve", default="",
+                    help="serve the live console at HOST:PORT")
+    args = ap.parse_args(argv)
+
+    managers = []
+    for spec in args.manager:
+        name, _, url = spec.partition("=")
+        if not url:
+            ap.error(f"--manager {spec!r}: expected NAME=URL")
+        managers.append((name, url))
+    if not managers and not args.hub:
+        ap.error("need at least one --manager or --hub")
+
+    from syzkaller_tpu.observe import FleetConsole
+    console = FleetConsole(managers, hub_url=args.hub or None,
+                           sync_age_threshold=args.sync_age,
+                           coverage_stall_threshold=args.coverage_stall)
+
+    if args.serve:
+        from http.server import BaseHTTPRequestHandler, \
+            ThreadingHTTPServer
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *a):
+                pass
+
+            def do_GET(self):
+                try:
+                    console.scrape()
+                    if self.path.startswith("/fleet"):
+                        body = json.dumps(console.fleet_json(),
+                                          default=str).encode()
+                        ctype = "application/json"
+                    else:
+                        body = console.render_html().encode()
+                        ctype = "text/html; charset=utf-8"
+                    self.send_response(200)
+                except Exception as e:
+                    body = str(e).encode()
+                    ctype = "text/plain"
+                    self.send_response(500)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+        host, _, port = args.serve.rpartition(":")
+        srv = ThreadingHTTPServer((host or "127.0.0.1", int(port)),
+                                  Handler)
+        print(f"console on http://{srv.server_address[0]}:"
+              f"{srv.server_address[1]} (/ = html, /fleet = json)",
+              file=sys.stderr)
+        t = threading.Thread(target=srv.serve_forever, daemon=True)
+        t.start()
+        try:
+            t.join()
+        except KeyboardInterrupt:
+            pass
+        return 0
+
+    console.scrape()
+    if args.html:
+        print(console.render_html())
+    else:
+        print(json.dumps(console.fleet_json(), default=str, indent=2))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
