@@ -1270,6 +1270,113 @@ def bench_tsdb(smoke=False):
             "tsdb_recompiles_warm": int(cc.count)}
 
 
+def bench_fuzz_tick(smoke=False):
+    """Single-dispatch fuzz tick: engine.fuzz_tick fuses
+    ingest-translate → signal-diff → admission gate/merge → tsdb bump →
+    decision draws into ONE host→device dispatch.  This stage proves
+    the fusion on the same batch stream three ways:
+
+      * `fuzz_tick_parity` — the fused frontier (max/corpus cover +
+        signal matrix + verdict stream) is BIT-exact vs the unfused
+        ingest_update_slabs + admit_slabs pair (presubmit gates this);
+      * `dispatches_per_tick_*` — counted by a DispatchProfiler (the
+        /profile/dispatches view), the fused path crosses the host
+        boundary once per batch where the unfused pair crosses twice;
+      * throughput on both paths, same workload.
+
+    `dispatch_top` is the profiler's top-10 table over this stage
+    (name, calls, seconds_sum, recompiles) — the flat view the fleet
+    console renders from /profile/dispatches."""
+    from syzkaller_tpu.cover.engine import CoverageEngine
+    from syzkaller_tpu.fuzzer.pcmap import DeviceKeyMirror, PcMap
+    from syzkaller_tpu.observe import DispatchProfiler
+
+    npcs, nkeys = 1 << 12, 3000
+    n = 48 if smoke else 512
+    rng = np.random.default_rng(21)
+
+    def mk():
+        eng = CoverageEngine(npcs=npcs, ncalls=16, corpus_cap=4096)
+        pm = PcMap(npcs)
+        pm.preseed(np.arange(0, nkeys, dtype=np.uint64))
+        mirror = DeviceKeyMirror(pm, put=eng.put_replicated)
+        mirror.refresh()
+        return eng, mirror
+
+    batches = []
+    for _ in range(n):
+        win = rng.integers(0, nkeys, (8, 32)).astype(np.uint32)
+        counts = rng.integers(1, 33, 8).astype(np.int32)
+        cids = rng.integers(0, 16, 8).astype(np.int32)
+        prev = rng.integers(-1, 16, 8).astype(np.int32)
+        batches.append((win, counts, cids, prev))
+
+    fus_eng, fus_m = mk()
+    unf_eng, unf_m = mk()
+    prof = DispatchProfiler()
+    prof.attach(fus_eng)
+    prof.attach(unf_eng)
+
+    def counts_total():
+        return sum(d["count"]
+                   for d in prof.snapshot()["dispatches"].values())
+
+    # warm both shape closures outside the counted window
+    w, c, ci, pv = batches[0]
+    fus_eng.fuzz_tick(w, c, ci, pv, fus_m)
+    unf_eng.ingest_update_slabs(w, c, ci, unf_m)
+    unf_eng.admit_slabs(w, c, ci, pv, unf_m)
+
+    base = counts_total()
+    t0 = time.perf_counter()
+    fused_verdicts = []
+    for w, c, ci, pv in batches[1:]:
+        res = fus_eng.fuzz_tick(w, c, ci, pv, fus_m)
+        fused_verdicts.append(res.has_new)
+    fused_dt = time.perf_counter() - t0
+    fused_dispatches = counts_total() - base
+
+    base = counts_total()
+    t0 = time.perf_counter()
+    unf_verdicts = []
+    for w, c, ci, pv in batches[1:]:
+        unf_eng.ingest_update_slabs(w, c, ci, unf_m)
+        hn, _rows, _ch = unf_eng.admit_slabs(w, c, ci, pv, unf_m)
+        unf_verdicts.append(hn)
+    unf_dt = time.perf_counter() - t0
+    unf_dispatches = counts_total() - base
+
+    ticks = len(batches) - 1
+    parity = (
+        all(np.array_equal(a, b)
+            for a, b in zip(fused_verdicts, unf_verdicts))
+        and np.array_equal(np.asarray(fus_eng.max_cover),
+                           np.asarray(unf_eng.max_cover))
+        and np.array_equal(np.asarray(fus_eng.corpus_cover),
+                           np.asarray(unf_eng.corpus_cover))
+        and np.array_equal(np.asarray(fus_eng.corpus_mat),
+                           np.asarray(unf_eng.corpus_mat))
+        and fus_eng.corpus_len == unf_eng.corpus_len)
+
+    snap = prof.snapshot()
+    top = sorted(((n_, d) for n_, d in snap["dispatches"].items()
+                  if d["count"]),
+                 key=lambda kv: kv[1]["sum_seconds"], reverse=True)[:10]
+    dispatch_top = [
+        {"name": name, "calls": d["count"],
+         "seconds_sum": round(d["sum_seconds"], 5),
+         "recompiles": snap["recompiles"].get(name, 0)}
+        for name, d in top]
+    return {
+        "fuzz_tick_parity": bool(parity),
+        "dispatches_per_tick_fused": round(fused_dispatches / ticks, 3),
+        "dispatches_per_tick_unfused": round(unf_dispatches / ticks, 3),
+        "fuzz_tick_batches_per_sec": round(ticks / fused_dt, 1),
+        "fuzz_tick_unfused_batches_per_sec": round(ticks / unf_dt, 1),
+        "dispatch_top": dispatch_top,
+    }
+
+
 def main(argv=None):
     ap = argparse.ArgumentParser()
     ap.add_argument("--smoke", action="store_true",
@@ -1364,6 +1471,8 @@ def main(argv=None):
     _stage("new-cov quality replay (zero-copy ingest)")
     extras.update(bench_new_cov_quality(np.random.default_rng(11),
                                         nexecs=(8 if args.smoke else 16) * B))
+    _stage("fused fuzz tick (single dispatch)")
+    extras.update(bench_fuzz_tick(smoke=args.smoke))
     _stage("corpus scale")
     extras.update(bench_corpus_scale(np.random.default_rng(13),
                                      C=2048 if args.smoke else 100_000))

@@ -365,49 +365,14 @@ def sparse_update(max_cover: jax.Array, call_ids: jax.Array,
     return mc.reshape(ncalls, W), new, has_new
 
 
-def translate_slab_rows(win: jax.Array, counts: jax.Array,
-                        skeys: jax.Array, svals: jax.Array,
-                        meta: jax.Array, direct_cap: int, overflow: int
-                        ) -> tuple[jax.Array, jax.Array, jax.Array]:
-    """On-device sparse→dense PC translation for one slab batch: the
-    PcMap's first-seen key table, mirrored as a sorted device array
-    (fuzzer/pcmap.py DeviceKeyMirror), probed with one vmapped binary
-    search per PC — the same O(log n)-per-element trick as the
-    decision-stream cdf draw, replacing the per-batch host
-    `_lookup`/scatter/dedup/pad packing that kept device replay behind
-    the CPU path.
-
-    win: (B, K) uint32 raw PCs (row i live in [:counts[i]]) — exactly
-    the ring's zero-copy slab window.  skeys/svals: (D,) sorted keys
-    (0xFFFFFFFF sentinel padding) and their dense indices.  meta: (2,)
-    int32 [n_live_keys, table_full].
-
-    Semantics match the host `_lookup` bit for bit: a hit returns the
-    stored dense index; a miss with the direct table FULL takes the
-    stateless hashed-overflow index (`direct_cap + pc % overflow`, the
-    `_map_flat_locked` formula — u32 and u64 mod agree on u32 values);
-    a miss with room left is a NEW key the caller must resolve
-    host-side (returned in the miss mask) — the kernel cannot assign
-    first-seen order.  Returns (idx, valid, miss)."""
-    B, K = win.shape
-    D = skeys.shape[0]
-    col = jnp.arange(K, dtype=jnp.int32)
-    in_row = col[None, :] < counts[:, None]
-    pos = jnp.searchsorted(skeys, win, side="left")
-    pos_c = jnp.clip(pos, 0, D - 1)
-    hit = (skeys[pos_c] == win) & (pos < meta[0])
-    idx = jnp.where(hit, svals[pos_c], jnp.int32(-1))
-    ovf = (win % jnp.uint32(overflow)).astype(jnp.int32) + direct_cap
-    table_full = meta[1] > 0
-    take_ovf = in_row & ~hit & table_full
-    idx = jnp.where(take_ovf, ovf, idx)
-    valid = in_row & (hit | take_ovf)
-    miss = in_row & ~hit & ~table_full
-    return idx, valid, miss
-
-
-def popcount_rows(mat: jax.Array) -> jax.Array:
-    return jax.lax.population_count(mat).sum(axis=-1, dtype=jnp.int32)
+# translate_slab_rows, popcount_rows, and the extracted signal_diff /
+# synth_gather oracles now live in kernels/oracles.py (re-exported here
+# for the long-standing import sites); the engine resolves the plane-
+# selected implementation through kernels.KERNELS at _build() time.
+from syzkaller_tpu.kernels import KERNELS  # noqa: E402
+from syzkaller_tpu.kernels.oracles import (popcount_rows,  # noqa: E402,F401
+                                           signal_diff, synth_gather,
+                                           translate_slab_rows)
 
 
 def minimize_cover(corpus: jax.Array, active: jax.Array) -> jax.Array:
@@ -788,6 +753,31 @@ class SparseUpdateResult:
 
 
 @dataclass
+class FuzzTickResult:
+    """One fused fuzz tick (engine.fuzz_tick): the union of an
+    IngestResult (signal plane) and an admit_slabs return (admission +
+    draws), produced by ONE dispatch.  Signal-plane fields stay device
+    arrays so DeviceSignal can keep its async resolve/absorb contract;
+    admission fields are host values (the caller needs them
+    synchronously for corpus bookkeeping anyway)."""
+    sig_has_new: jax.Array       # (B,) bool device — vs max cover
+    sig_new_bits: jax.Array      # (B, W) device diff bitmaps
+    has_new: np.ndarray          # (B,) bool host — admission verdicts
+    rows: "np.ndarray | None"    # assigned corpus rows (None: cap fallback)
+    choices: np.ndarray          # (P,) pre-drawn next-call ids
+    new_bits: np.ndarray         # (B,) per-input new-bit counts
+    miss_rows: jax.Array         # (B,) bool device — first-sight rows
+    fused: bool = True           # False when the cap fallback ran unfused
+
+    def signal_view(self) -> "IngestResult":
+        """The signal-plane slice as an IngestResult — what
+        SparseView.absorb and the DeviceSignal resolve path consume."""
+        return IngestResult(has_new=self.sig_has_new,
+                            new_bits=self.sig_new_bits,
+                            miss_rows=self.miss_rows)
+
+
+@dataclass
 class IngestResult:
     """One zero-copy slab-batch ingest dispatch (translate + pack +
     diff/merge fused): every field is a device array the caller fetches
@@ -814,8 +804,15 @@ class CoverageEngine:
                  batch: int = 64, max_pcs_per_exec: int = 512,
                  mesh: "Mesh | None" = None, seed: int = 0,
                  block_words: int = 2, max_touched_blocks: int = 0,
-                 telemetry=None):
+                 telemetry=None, kernel_plane: str = "auto"):
         self.npcs = npcs
+        # which implementation the registered hot kernels resolve to
+        # (kernels.KERNELS planes: auto/jnp/pallas/pallas-interpret).
+        # Resolution happens ONCE per _build(), so every jitted closure
+        # keeps one signature per plane and a ResilientEngine standby
+        # built with kernel_plane="jnp" swaps in compile-free.
+        self.kernel_plane = kernel_plane
+        self.active_plane = KERNELS.resolve_plane(kernel_plane)
         # telemetry: a telemetry.device.DeviceStats whose fixed-slot
         # int32 vector the fused dispatches bump in place (.at[].add
         # inside the jit) — hot-loop counting without extra round trips.
@@ -907,6 +904,15 @@ class CoverageEngine:
     def _build(self) -> None:
         npcs = self.npcs
         ds = self.tstats
+        # plane-selected hot kernels: every closure below closes over
+        # these callables, resolved ONCE here (registry.fn is a
+        # build-time decision — see kernels/registry.py).  On TPU-like
+        # backends these are the pallas twins; everywhere else the jnp
+        # oracles, which double as the bit-exactness reference.
+        self.active_plane = KERNELS.resolve_plane(self.kernel_plane)
+        k_translate = KERNELS.fn("translate_slab_rows", self.kernel_plane)
+        k_sigdiff = KERNELS.fn("signal_diff", self.kernel_plane)
+        k_sgather = KERNELS.fn("synth_gather", self.kernel_plane)
 
         def _bump(svec, hinc, batch_slot, rows_slot, new_slot,
                   valid, has_new, extra=()):
@@ -1005,11 +1011,9 @@ class CoverageEngine:
         @jax.jit
         def _diff_vs(base, call_ids, pc_idx, valid, flakes):
             bitmaps = pack_pcs(pc_idx, valid, npcs, assume_unique=True)
-            prev = base[call_ids]
-            fl = flakes[call_ids]
-            new = jnp.bitwise_and(bitmaps,
-                                  jnp.bitwise_not(jnp.bitwise_or(prev, fl)))
-            return new, jnp.any(new != 0, axis=-1), bitmaps
+            prev = jnp.bitwise_or(base[call_ids], flakes[call_ids])
+            new, has_new, _nbits = k_sigdiff(prev, bitmaps)
+            return new, has_new, bitmaps
 
         @functools.partial(jax.jit, donate_argnums=(0,))
         def _admit(corpus_mat, bitmaps, admit_mask, start):
@@ -1131,7 +1135,7 @@ class CoverageEngine:
                            static_argnums=(8, 9))
         def _ingest_update(max_cover, win, counts, call_ids, skeys,
                            svals, meta, svec, direct_cap, overflow, hinc):
-            idx, valid, miss = translate_slab_rows(
+            idx, valid, miss = k_translate(
                 win, counts, skeys, svals, meta, direct_cap, overflow)
             # overflow aliasing can duplicate an index within a row —
             # sort-dedup inside the pack (host map_rows dedups too)
@@ -1160,7 +1164,7 @@ class CoverageEngine:
             retired.  The caller pre-resolves first-sight keys
             (DeviceKeyMirror.ensure), so misses cannot occur; the mask
             still rides back as a cheap invariant check."""
-            idx, valid, miss = translate_slab_rows(
+            idx, valid, miss = k_translate(
                 win, counts, skeys, svals, meta, direct_cap, overflow)
             bitmaps = pack_pcs(idx, valid, npcs, assume_unique=False)
             gate = jnp.bitwise_or(corpus_cover, flakes)
@@ -1184,6 +1188,59 @@ class CoverageEngine:
                     jnp.sum(counts, dtype=jnp.int32) * 4)
             return cover, mat, has_new, rowbits, draws, miss_rows, svec
 
+        @functools.partial(jax.jit, donate_argnums=(0, 1, 2),
+                           static_argnums=(16, 17))
+        def _fuzz_tick(max_cover, corpus_cover, corpus_mat, flakes, win,
+                       counts, call_ids, start, key, prios, enabled,
+                       prev, skeys, svals, meta, svec, direct_cap,
+                       overflow, hinc):
+            """ONE whole fuzz tick in ONE dispatch: ingest-translate →
+            signal diff/merge into max cover → admission gate + corpus
+            merge → tsdb slot bumps → decision draws.  The unfused
+            path pays two host→device boundary crossings per batch
+            (ingest_update_slabs for the signal plane, then admit_slabs
+            for admission + draws); this closure is their exact
+            composition — same kernels, same in-batch sequencing
+            (diff_merge both times), same stat slots plus the
+            tick_batches marker — so fused-vs-unfused stays frontier
+            bit-exact while the host boundary is crossed once.
+
+            Donates all three big matrices (max cover, corpus cover,
+            corpus signal matrix): steady-state ticks move only the
+            slab window in and verdict vectors out."""
+            idx, valid, miss = k_translate(
+                win, counts, skeys, svals, meta, direct_cap, overflow)
+            bitmaps = pack_pcs(idx, valid, npcs, assume_unique=False)
+            merged, sig_new, sig_has = diff_merge(max_cover, call_ids,
+                                                  bitmaps)
+            gate = jnp.bitwise_or(corpus_cover, flakes)
+            _g, _new, has_new = diff_merge(gate, call_ids, bitmaps)
+            rowbits = popcount_rows(_new)
+            rows = jnp.where(has_new[:, None], bitmaps, jnp.uint32(0))
+            cover = scatter_or(corpus_cover, call_ids, rows)
+            ridx = jnp.cumsum(has_new.astype(jnp.int32)) - 1 + start
+            ridx = jnp.where(has_new, ridx, corpus_mat.shape[0])
+            mat = corpus_mat.at[ridx].set(bitmaps, mode="drop")
+            draws = sample_calls(key, prios, prev, enabled)
+            miss_rows = jnp.any(miss, axis=1)
+            if ds is not None:
+                svec = _bump(svec, hinc, "admit_batches", "admit_inputs",
+                             "admit_admitted", valid, has_new,
+                             extra=[("admit_draws", prev.shape[0])])
+                svec = svec.at[ds.slot("dense_batches")].add(1)
+                svec = svec.at[ds.slot("dense_rows")].add(
+                    jnp.sum(valid.any(axis=-1), dtype=jnp.int32))
+                svec = svec.at[ds.slot("dense_newsig")].add(
+                    jnp.sum(sig_has, dtype=jnp.int32))
+                svec = svec.at[ds.slot("ingest_batches")].add(1)
+                svec = svec.at[ds.slot("ingest_slabs")].add(
+                    jnp.sum(counts > 0, dtype=jnp.int32))
+                svec = svec.at[ds.slot("ingest_bytes")].add(
+                    jnp.sum(counts, dtype=jnp.int32) * 4)
+                svec = svec.at[ds.slot("tick_batches")].add(1)
+            return (merged, cover, mat, sig_has, sig_new, has_new,
+                    rowbits, draws, miss_rows, svec)
+
         @functools.partial(jax.jit, static_argnums=(8, 9))
         def _ingest_diff(base, flakes, win, counts, call_ids, skeys,
                          svals, meta, direct_cap, overflow):
@@ -1192,27 +1249,24 @@ class CoverageEngine:
             rows too: the caller reads each PC's verdict through its
             own index (overflow aliasing degrades to a shared verdict,
             matching the host path)."""
-            idx, valid, miss = translate_slab_rows(
+            idx, valid, miss = k_translate(
                 win, counts, skeys, svals, meta, direct_cap, overflow)
             bitmaps = pack_pcs(idx, valid, npcs, assume_unique=False)
-            prev = base[call_ids]
-            fl = flakes[call_ids]
-            new = jnp.bitwise_and(bitmaps,
-                                  jnp.bitwise_not(jnp.bitwise_or(prev, fl)))
-            return (new, jnp.any(new != 0, axis=-1), bitmaps, idx,
-                    jnp.any(miss, axis=1))
+            prev = jnp.bitwise_or(base[call_ids], flakes[call_ids])
+            new, has_new, _nbits = k_sigdiff(prev, bitmaps)
+            return new, has_new, bitmaps, idx, jnp.any(miss, axis=1)
 
         @functools.partial(jax.jit, static_argnums=(5, 6))
         def _ingest_pack(win, counts, skeys, svals, meta, direct_cap,
                          overflow):
-            idx, valid, _miss = translate_slab_rows(
+            idx, valid, _miss = k_translate(
                 win, counts, skeys, svals, meta, direct_cap, overflow)
             return pack_pcs(idx, valid, npcs, assume_unique=False)
 
         @functools.partial(jax.jit, static_argnums=(5, 6))
         def _ingest_pack_or(win, counts, skeys, svals, meta, direct_cap,
                             overflow):
-            idx, valid, _miss = translate_slab_rows(
+            idx, valid, _miss = k_translate(
                 win, counts, skeys, svals, meta, direct_cap, overflow)
             bm = pack_pcs(idx, valid, npcs, assume_unique=False)
             return jax.lax.reduce(bm, jnp.uint32(0), jax.lax.bitwise_or,
@@ -1416,32 +1470,10 @@ class CoverageEngine:
             sstart = jnp.where(is_t, 0, c_start)
 
             # the assembly gather: out word j ← segment e covering j
-            def emit_one(ends_i, starts_i, sstart_i, row_i, ist_i,
-                         total_i):
-                j = jnp.arange(L, dtype=jnp.int32)
-                e = jnp.clip(
-                    jnp.searchsorted(ends_i, j, side="right"), 0,
-                    CO - 1)
-                off = sstart_i[e] + (j - starts_i[e])
-                rc = jnp.clip(row_i[e], 0, R - 1)
-                rt = jnp.clip(row_i[e], 0, Tn - 1)
-                lo = jnp.where(ist_i[e],
-                               t_lo[rt, jnp.clip(off, 0, LT - 1)],
-                               rows_lo[rc, jnp.clip(off, 0, L - 1)])
-                hi = jnp.where(ist_i[e],
-                               t_hi[rt, jnp.clip(off, 0, LT - 1)],
-                               rows_hi[rc, jnp.clip(off, 0, L - 1)])
-                eof = jnp.uint32(0xFFFFFFFF)
-                lo = jnp.where(j < total_i, lo,
-                               jnp.where(j == total_i, eof,
-                                         jnp.uint32(0)))
-                hi = jnp.where(j < total_i, hi,
-                               jnp.where(j == total_i, eof,
-                                         jnp.uint32(0)))
-                return lo, hi
-
-            lo, hi = jax.vmap(emit_one)(ends, starts, sstart, row,
-                                        is_t, total)
+            # (kernels.synth_gather — jnp oracle or its pallas twin,
+            # whichever this engine's plane resolved)
+            lo, hi = k_sgather(ends, starts, sstart, row, is_t, total,
+                               rows_lo, rows_hi, t_lo, t_hi)
 
             # mutate-arg post-edit: one const value word rewritten
             u_mut = jax.random.uniform(k_mut, (B, 5))
@@ -1511,6 +1543,7 @@ class CoverageEngine:
                     jnp.where(has_slot, a, -1), kind, new_lo, new_hi,
                     nkept, svec)
 
+        self._fuzz_tick_fn = _fuzz_tick
         self._synth_fn = _synth
         self._random_bits_fn = _random_bits
         self._ingest_update_fn = _ingest_update
@@ -1747,6 +1780,67 @@ class CoverageEngine:
         if with_new_bits:
             return has_new, rows, choices, np.asarray(nbits)
         return has_new, rows, choices
+
+    @_locked
+    def fuzz_tick(self, win, counts, call_ids, choice_prev,
+                  mirror) -> FuzzTickResult:
+        """One whole fuzz tick — signal merge + admission + decision
+        draws — in ONE host→device dispatch (the _fuzz_tick closure).
+        Semantically it IS ingest_update_slabs followed by admit_slabs
+        on the same batch: fused-vs-unfused frontiers are bit-exact
+        (presubmit gates this).  Like admit_slabs, first-sight keys
+        must be pre-resolved (mirror.ensure) — unresolved misses raise
+        AFTER the signal merge (which is miss-tolerant) but before any
+        admission bookkeeping is reported.
+
+        Falls back to the unfused pair when the corpus matrix cannot
+        take the whole batch (the serial drop-the-input semantics),
+        marked fused=False so callers/bench can count it."""
+        win, counts, call_ids = self._slab_fit(win, counts, call_ids)
+        skeys, svals, meta, dc, ov = self._mirror_ops(mirror)
+        n_in = int(call_ids.shape[0])
+        prev = jnp.asarray(choice_prev, jnp.int32)
+        if self.corpus_len + n_in > self.cap:
+            svec, hinc = self._ts_in()
+            (self.max_cover, sig_new, sig_has, miss_rows,
+             svec) = self._ingest_update_fn(
+                self.max_cover, win, counts, call_ids, skeys, svals,
+                meta, svec, dc, ov, hinc)
+            self._ts_out(svec)
+            new, has_new, _bm, _idx, miss2 = self._ingest_diff_fn(
+                self.corpus_cover, self.flakes, win, counts, call_ids,
+                skeys, svals, meta, dc, ov)
+            if bool(np.asarray(miss2).any()):
+                raise ValueError("fuzz_tick: unresolved first-sight "
+                                 "keys (call mirror.ensure first)")
+            choices = self.sample_next_calls(np.asarray(prev))
+            return FuzzTickResult(
+                sig_has_new=sig_has, sig_new_bits=sig_new,
+                has_new=np.asarray(has_new), rows=None,
+                choices=np.asarray(choices),
+                new_bits=np.asarray(self._popcount_fn(new)),
+                miss_rows=miss_rows, fused=False)
+        svec, hinc = self._ts_in()
+        (self.max_cover, self.corpus_cover, self.corpus_mat, sig_has,
+         sig_new, has_new, nbits, choices, miss_rows,
+         svec) = self._fuzz_tick_fn(
+            self.max_cover, self.corpus_cover, self.corpus_mat,
+            self.flakes, win, counts, call_ids,
+            jnp.int32(self.corpus_len), self._next_key(), self.prios,
+            self.enabled, prev, skeys, svals, meta, svec, dc, ov, hinc)
+        self._ts_out(svec)
+        has_new = np.asarray(has_new)
+        if bool(np.asarray(miss_rows).any()):
+            raise ValueError("fuzz_tick: unresolved first-sight keys "
+                             "(call mirror.ensure first)")
+        admitted = np.nonzero(has_new)[0]
+        rows = np.arange(self.corpus_len, self.corpus_len + len(admitted))
+        self.corpus_call[rows] = np.asarray(call_ids)[admitted]
+        self.corpus_len += len(admitted)
+        return FuzzTickResult(
+            sig_has_new=sig_has, sig_new_bits=sig_new, has_new=has_new,
+            rows=rows, choices=np.asarray(choices),
+            new_bits=np.asarray(nbits), miss_rows=miss_rows)
 
     def triage_diff_slabs(self, win, counts, call_ids, mirror):
         """Slab-path triage gate: translate + diff vs corpus cover

@@ -414,6 +414,39 @@ class DecisionStream:
                 self._ent.append(words)
                 self._ent_len += len(words)
 
+    def feed(self, prev_call_id: int, draws, epoch: "int | None" = None
+             ) -> int:
+        """Bank externally pre-drawn decisions — the fused fuzz tick's
+        ride-along choice draws (engine.fuzz_tick /
+        DeviceSignal.submit_tick decision_sink) — into one context's
+        ring, under the same rules as a prefetched block: ring caps
+        (ring_mult × target) are respected and, when the caller
+        snapshotted `epoch()` before dispatching the tick, a stale
+        epoch discards instead of publishing pre-invalidation draws.
+        Returns the number of decisions banked."""
+        vals = np.asarray(draws, np.int64).ravel()
+        if vals.size == 0:
+            return 0
+        with self._mu:
+            if epoch is not None and epoch != self._epoch:
+                self.stat_discarded += 1
+                return 0
+            q = self._rings.setdefault(prev_call_id, deque())
+            room = self.ring_mult * int(self._targets[prev_call_id + 1]) \
+                - len(q)
+            if room <= 0:
+                return 0
+            add = vals[:room].tolist()
+            q.extend(add)
+            self._inv_total += len(add)
+            return len(add)
+
+    def epoch(self) -> int:
+        """Current invalidation epoch — snapshot before dispatching a
+        fused tick whose draws will be feed()-banked."""
+        with self._mu:
+            return self._epoch
+
     def _maybe_adapt(self) -> None:
         """Re-split the hot-slot budget by observed drain rates so hot
         rows stop starving: the prev composition (operand CONTENTS, not

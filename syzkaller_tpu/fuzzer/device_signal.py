@@ -180,6 +180,62 @@ class DeviceSignal:
                                 time.monotonic() - t0)
         return has_new[: len(counts)]
 
+    def submit_tick(self, win: np.ndarray, counts: np.ndarray,
+                    call_ids: np.ndarray, choice_prev=None,
+                    corpus_indices=None, decision_sink=None):
+        """ONE whole fuzz tick for a slab window: signal diff/merge +
+        admission gate/corpus merge + pre-drawn decision draws in a
+        single host→device dispatch (engine.fuzz_tick) — the fused
+        successor of submit_slabs-then-admission.  Admission results
+        (has_new/rows/choices) land synchronously in the returned
+        FuzzTickResult; the signal-plane verdict stays a device array
+        behind the ticket, preserving the pipelined resolve/absorb
+        contract.
+
+        Unlike submit_slabs, first-sight keys are pre-resolved here
+        with ONE vectorized mirror.ensure probe (a pure lookup pass in
+        steady state) — the admission gate cannot defer misses without
+        changing the admitted set.  `corpus_indices` (per slab row)
+        feeds the device-row→corpus map for admitted rows;
+        `decision_sink` (e.g. DecisionStream.feed bound to a prev
+        context) receives the tick's pre-drawn next-call ids.
+
+        Returns (ticket, FuzzTickResult)."""
+        win = np.asarray(win)
+        counts = np.asarray(counts, np.int32)
+        call_ids = np.asarray(call_ids, np.int32)
+        live = np.arange(win.shape[1])[None, :] < counts[:, None]
+        self.mirror.ensure(win[live])
+        if choice_prev is None:
+            choice_prev = np.full((self.B,), -1, np.int32)
+        res = self.engine.fuzz_tick(win, counts, call_ids,
+                                    choice_prev=choice_prev,
+                                    mirror=self.mirror)
+        self.stat_ingest_dispatches += 1
+        if res.rows is not None and len(res.rows):
+            owners = (np.full(len(res.rows), -1, np.int64)
+                      if corpus_indices is None
+                      else np.asarray(corpus_indices)[res.has_new])
+            with self._row_mu:
+                self._row2corpus.extend(int(x) for x in owners)
+        elif res.rows is None:
+            self.stat_corpus_full += 1
+        if decision_sink is not None:
+            decision_sink(res.choices)
+        ticket = ("tick", res, win, counts, call_ids, self._frontier,
+                  time.monotonic())
+        return ticket, res
+
+    def _resolve_tick(self, ticket) -> np.ndarray:
+        _kind, res, _win, counts, call_ids, frontier, t0 = ticket
+        has_new = np.asarray(res.sig_has_new)        # the host sync
+        if frontier is not None:
+            frontier.absorb(call_ids, res.signal_view())
+        if self.tstats is not None:
+            self.tstats.observe("ingest_translate_latency",
+                                time.monotonic() - t0)
+        return has_new[: len(counts)]
+
     def _fixup_misses(self, win, counts, call_ids, miss, has_new,
                       frontier) -> np.ndarray:
         """Host-resolve first-sight keys for the flagged rows (exact
@@ -266,6 +322,8 @@ class DeviceSignal:
         kind = ticket[0]
         if kind == "slab":
             return self._resolve_slab(ticket)
+        if kind == "tick":
+            return self._resolve_tick(ticket)
         if kind == "wrap":
             _k, inner, owner, n = ticket
             has_new = self._resolve_slab(inner)

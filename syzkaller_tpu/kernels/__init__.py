@@ -1,0 +1,37 @@
+"""Pallas hot-kernel plane.
+
+`KERNELS` is the process-wide registry binding each hot kernel's jnp
+oracle (kernels/oracles.py — the semantics and the CPU/fallback plane)
+to its pallas twin (kernels/pallas_plane.py) and the parity test that
+proves them bit-exact.  The engine resolves callables through
+`KERNELS.fn(name, plane)` at `_build` time; see registry.py for the
+plane rules and the zero-recompile failover contract.
+"""
+
+from __future__ import annotations
+
+from syzkaller_tpu.kernels.oracles import (popcount_rows, signal_diff,
+                                           synth_gather,
+                                           translate_slab_rows)
+from syzkaller_tpu.kernels.pallas_plane import (signal_diff_pallas,
+                                                synth_gather_pallas,
+                                                translate_slab_rows_pallas)
+from syzkaller_tpu.kernels.registry import (KernelRegistry, KernelSpec,
+                                            TPU_BACKENDS)
+
+KERNELS = KernelRegistry()
+KERNELS.register(
+    "signal_diff", oracle=signal_diff, pallas=signal_diff_pallas,
+    parity_test="tests/test_kernels.py::test_signal_diff_parity")
+KERNELS.register(
+    "translate_slab_rows", oracle=translate_slab_rows,
+    pallas=translate_slab_rows_pallas,
+    parity_test="tests/test_kernels.py::test_translate_slab_rows_parity")
+KERNELS.register(
+    "synth_gather", oracle=synth_gather, pallas=synth_gather_pallas,
+    parity_test="tests/test_kernels.py::test_synth_gather_parity")
+
+__all__ = ["KERNELS", "KernelRegistry", "KernelSpec", "TPU_BACKENDS",
+           "popcount_rows", "signal_diff", "synth_gather",
+           "translate_slab_rows", "signal_diff_pallas",
+           "synth_gather_pallas", "translate_slab_rows_pallas"]

@@ -1,0 +1,131 @@
+"""jnp oracle implementations for every registered hot kernel.
+
+These are THE semantics: each function here is the bit-exactness
+oracle its pallas twin (kernels/pallas_plane.py) is tested against,
+and the implementation the engine falls back to on CPU/GPU backends
+(and inside the ResilientEngine's CPU fallback plane).  They are pure
+jittable array programs — no engine state, no Python-side iteration —
+so the engine's fused closures can call either plane interchangeably
+through the KernelRegistry without changing a dispatch signature.
+
+History: `translate_slab_rows` and `popcount_rows` lived in
+cover/engine.py (which still re-exports them); `signal_diff` and
+`synth_gather` were inlined in the engine's `_diff_vs`/`_ingest_diff`
+and `_synth` closures and are extracted here so the registry can name
+them.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def popcount_rows(mat: jax.Array) -> jax.Array:
+    """(…, W) words → (…,) per-row set-bit counts (int32)."""
+    return jax.lax.population_count(mat).sum(axis=-1, dtype=jnp.int32)
+
+
+def signal_diff(prev: jax.Array, bitmaps: jax.Array
+                ) -> tuple[jax.Array, jax.Array, jax.Array]:
+    """The word-OR + popcount hot step: per-exec new-signal vs an
+    already-gathered prev-cover row set.
+
+    prev: (B, W) uint32 — row i's prior cover (the caller gathers
+    base[call_ids] | flakes[call_ids]; keeping the gather outside makes
+    the kernel a pure streaming diff, the shape the pallas plane tiles).
+    bitmaps: (B, W) uint32 exec bitmaps.
+
+    Returns (new, has_new, nbits): the (B, W) diff bitmaps, the (B,)
+    bool verdicts, and the (B,) int32 new-bit counts — nbits rides
+    along because the diff rows are already materialized (the fused
+    popcount-reduce the profiler flagged as a separate pass)."""
+    new = jnp.bitwise_and(bitmaps, jnp.bitwise_not(prev))
+    nbits = popcount_rows(new)
+    return new, nbits > 0, nbits
+
+
+def translate_slab_rows(win: jax.Array, counts: jax.Array,
+                        skeys: jax.Array, svals: jax.Array,
+                        meta: jax.Array, direct_cap: int, overflow: int
+                        ) -> tuple[jax.Array, jax.Array, jax.Array]:
+    """On-device sparse→dense PC translation for one slab batch: the
+    PcMap's first-seen key table, mirrored as a sorted device array
+    (fuzzer/pcmap.py DeviceKeyMirror), probed with one vmapped binary
+    search per PC — the same O(log n)-per-element trick as the
+    decision-stream cdf draw, replacing the per-batch host
+    `_lookup`/scatter/dedup/pad packing that kept device replay behind
+    the CPU path.
+
+    win: (B, K) uint32 raw PCs (row i live in [:counts[i]]) — exactly
+    the ring's zero-copy slab window.  skeys/svals: (D,) sorted keys
+    (0xFFFFFFFF sentinel padding) and their dense indices.  meta: (2,)
+    int32 [n_live_keys, table_full].
+
+    Semantics match the host `_lookup` bit for bit: a hit returns the
+    stored dense index; a miss with the direct table FULL takes the
+    stateless hashed-overflow index (`direct_cap + pc % overflow`, the
+    `_map_flat_locked` formula — u32 and u64 mod agree on u32 values);
+    a miss with room left is a NEW key the caller must resolve
+    host-side (returned in the miss mask) — the kernel cannot assign
+    first-seen order.  Returns (idx, valid, miss)."""
+    B, K = win.shape
+    D = skeys.shape[0]
+    col = jnp.arange(K, dtype=jnp.int32)
+    in_row = col[None, :] < counts[:, None]
+    pos = jnp.searchsorted(skeys, win, side="left")
+    pos_c = jnp.clip(pos, 0, D - 1)
+    hit = (skeys[pos_c] == win) & (pos < meta[0])
+    idx = jnp.where(hit, svals[pos_c], jnp.int32(-1))
+    ovf = (win % jnp.uint32(overflow)).astype(jnp.int32) + direct_cap
+    table_full = meta[1] > 0
+    take_ovf = in_row & ~hit & table_full
+    idx = jnp.where(take_ovf, ovf, idx)
+    valid = in_row & (hit | take_ovf)
+    miss = in_row & ~hit & ~table_full
+    return idx, valid, miss
+
+
+def synth_gather(ends: jax.Array, starts: jax.Array, sstart: jax.Array,
+                 row: jax.Array, is_t: jax.Array, total: jax.Array,
+                 rows_lo: jax.Array, rows_hi: jax.Array,
+                 t_lo: jax.Array, t_hi: jax.Array
+                 ) -> tuple[jax.Array, jax.Array]:
+    """The synth megakernel's assembly gather: out word j ← the segment
+    e covering j, sourced from either a corpus row or a template row.
+
+    ends/starts: (B, CO) int32 cumulative segment bounds (ends is
+    nondecreasing per program — the truncation rule already zeroed
+    dropped segments).  sstart: (B, CO) source start offset per
+    segment.  row: (B, CO) source row (corpus row or template id).
+    is_t: (B, CO) bool — segment sources from the template bank.
+    total: (B,) int32 live words per program (EOF word appended at
+    position `total`).  rows_lo/rows_hi: (R, L) uint32 corpus program
+    word halves; t_lo/t_hi: (Tn, LT) template word halves.
+
+    Returns the (B, L) lo/hi uint32 program slabs."""
+    R, L = rows_lo.shape
+    Tn, LT = t_lo.shape
+    CO = ends.shape[1]
+
+    def emit_one(ends_i, starts_i, sstart_i, row_i, ist_i, total_i):
+        j = jnp.arange(L, dtype=jnp.int32)
+        e = jnp.clip(
+            jnp.searchsorted(ends_i, j, side="right"), 0, CO - 1)
+        off = sstart_i[e] + (j - starts_i[e])
+        rc = jnp.clip(row_i[e], 0, R - 1)
+        rt = jnp.clip(row_i[e], 0, Tn - 1)
+        lo = jnp.where(ist_i[e],
+                       t_lo[rt, jnp.clip(off, 0, LT - 1)],
+                       rows_lo[rc, jnp.clip(off, 0, L - 1)])
+        hi = jnp.where(ist_i[e],
+                       t_hi[rt, jnp.clip(off, 0, LT - 1)],
+                       rows_hi[rc, jnp.clip(off, 0, L - 1)])
+        eof = jnp.uint32(0xFFFFFFFF)
+        lo = jnp.where(j < total_i, lo,
+                       jnp.where(j == total_i, eof, jnp.uint32(0)))
+        hi = jnp.where(j < total_i, hi,
+                       jnp.where(j == total_i, eof, jnp.uint32(0)))
+        return lo, hi
+
+    return jax.vmap(emit_one)(ends, starts, sstart, row, is_t, total)

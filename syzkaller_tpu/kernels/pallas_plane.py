@@ -1,0 +1,250 @@
+"""Pallas twins for the registered hot kernels.
+
+Design rules (see /opt/skills/guides pallas notes and the README
+"Pallas kernel plane" section):
+
+  * explicit VMEM tiling — every kernel picks pow2 block shapes via
+    `_tile` (shapes arrive pow2-bucketed from the dispatch planes, so
+    the largest pow2 divisor IS the dimension up to the cap), sized so
+    double-buffered working sets stay well under the ~16 MB/core VMEM
+    budget;
+  * double-buffered HBM streaming for free — a multi-step grid whose
+    index_map advances per step gets the pallas pipeline's automatic
+    prefetch of block k+1 while k computes; small lookup tables use a
+    constant index_map so they are fetched once and stay VMEM-resident
+    across grid steps;
+  * fused reductions — signal_diff popcounts its diff tile while the
+    tile is still in VMEM, accumulating into a revisited (TB, 1)
+    output block instead of re-reading the (B, W) diff from HBM;
+  * no Python-side data-proportional loops in bodies or index maps
+    (the vet `pallas-host-loop` rule): iteration is grid steps,
+    `lax.fori_loop` with source-constant trip counts (the binary
+    search runs bit_length(D)+1 steps), or vectorized compares;
+  * 2D iota only (`jax.lax.broadcasted_iota`), per the TPU lowering
+    requirement.
+
+Every kernel takes its oracle's positional signature plus a
+keyword-only `interpret` flag; `interpret=True` runs the same body on
+the pallas interpreter (CPU), which is how tier-1 proves bit-exactness
+against kernels/oracles.py without a TPU attached.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+
+def _tile(n: int, cap: int) -> int:
+    """Largest pow2 divisor of n, capped — the block edge for a
+    pow2-bucketed dimension of size n."""
+    return min(n & -n, cap) if n > 0 else 1
+
+
+# -- signal_diff ------------------------------------------------------------
+
+
+def _signal_diff_body(prev_ref, bm_ref, new_ref, nb_ref):
+    j = pl.program_id(1)
+    new = jnp.bitwise_and(bm_ref[...], jnp.bitwise_not(prev_ref[...]))
+    new_ref[...] = new
+    part = jax.lax.population_count(new).sum(
+        axis=1, dtype=jnp.int32)[:, None]
+
+    @pl.when(j == 0)
+    def _init():
+        nb_ref[...] = part
+
+    @pl.when(j != 0)
+    def _acc():
+        nb_ref[...] += part
+
+
+def signal_diff_pallas(prev, bitmaps, *, interpret: bool = False):
+    """Tiled word-OR diff with fused popcount-reduce.
+
+    Grid (B/TB, W/TW): prev/bitmaps stream through VMEM in (TB, TW)
+    tiles (pipeline double-buffers the HBM reads); the per-row bit
+    count accumulates across the W axis in a revisited (TB, 1) block,
+    so the popcount never re-reads the diff from HBM.  With TB=128,
+    TW=512 the double-buffered working set is 3 tiles x 2 x 256 KB =
+    1.5 MB of VMEM."""
+    B, W = prev.shape
+    TB, TW = _tile(B, 128), _tile(W, 512)
+    new, nbits = pl.pallas_call(
+        _signal_diff_body,
+        grid=(B // TB, W // TW),
+        in_specs=[pl.BlockSpec((TB, TW), lambda i, j: (i, j)),
+                  pl.BlockSpec((TB, TW), lambda i, j: (i, j))],
+        out_specs=[pl.BlockSpec((TB, TW), lambda i, j: (i, j)),
+                   pl.BlockSpec((TB, 1), lambda i, j: (i, 0))],
+        out_shape=[jax.ShapeDtypeStruct((B, W), jnp.uint32),
+                   jax.ShapeDtypeStruct((B, 1), jnp.int32)],
+        interpret=interpret,
+    )(prev, bitmaps)
+    nbits = nbits[:, 0]
+    return new, nbits > 0, nbits
+
+
+# -- translate_slab_rows ----------------------------------------------------
+
+
+def _bsearch_left(keys_ref, q, D: int):
+    """Branch-free searchsorted-left over the resident (1, D) sorted
+    key table: bit_length(D)+1 halving steps, each one vectorized
+    compare over the whole (TB, K) query tile."""
+    lo = jnp.zeros(q.shape, jnp.int32)
+    hi = jnp.full(q.shape, D, jnp.int32)
+
+    def step(_, lohi):
+        lo, hi = lohi
+        mid = (lo + hi) // 2
+        km = keys_ref[0, jnp.clip(mid, 0, D - 1)]
+        go_r = km < q
+        return (jnp.where(go_r, mid + 1, lo),
+                jnp.where(go_r, hi, mid))
+
+    lo, _ = jax.lax.fori_loop(0, D.bit_length() + 1, step, (lo, hi))
+    return lo
+
+
+def _translate_body(direct_cap, overflow, K, D,
+                    win_ref, cnt_ref, keys_ref, vals_ref, meta_ref,
+                    idx_ref, val_ref, miss_ref):
+    w = win_ref[...]
+    TB = w.shape[0]
+    col = jax.lax.broadcasted_iota(jnp.int32, (TB, K), 1)
+    in_row = col < cnt_ref[...]              # cnt block is (TB, 1)
+    pos = _bsearch_left(keys_ref, w, D)
+    pos_c = jnp.clip(pos, 0, D - 1)
+    hit = (keys_ref[0, pos_c] == w) & (pos < meta_ref[0, 0])
+    idx = jnp.where(hit, vals_ref[0, pos_c], jnp.int32(-1))
+    ovf = (w % jnp.uint32(overflow)).astype(jnp.int32) + direct_cap
+    table_full = meta_ref[0, 1] > 0
+    take_ovf = in_row & ~hit & table_full
+    idx_ref[...] = jnp.where(take_ovf, ovf, idx)
+    val_ref[...] = in_row & (hit | take_ovf)
+    miss_ref[...] = in_row & ~hit & ~table_full
+
+
+def translate_slab_rows_pallas(win, counts, skeys, svals, meta,
+                               direct_cap: int, overflow: int, *,
+                               interpret: bool = False):
+    """Tiled slab translation: (TB, K) PC tiles stream through VMEM
+    (double-buffered by the grid pipeline) while the sorted key/value
+    mirror and meta sit VMEM-resident across all grid steps (constant
+    index_map -> fetched once).  The binary search is the branch-free
+    halving loop in `_bsearch_left`; everything else is the oracle's
+    hit/overflow/miss masking verbatim.
+
+    Residency budget: the (D,) mirror is 2 x 4 B x D — the default
+    64 Ki-key mirror is 512 KB, far under VMEM; tiles add 3 x TB x K x
+    4 B double-buffered."""
+    B, K = win.shape
+    D = int(skeys.shape[0])
+    TB = _tile(B, 256)
+    body = functools.partial(_translate_body, int(direct_cap),
+                             int(overflow), K, D)
+    return pl.pallas_call(
+        body,
+        grid=(B // TB,),
+        in_specs=[pl.BlockSpec((TB, K), lambda i: (i, 0)),
+                  pl.BlockSpec((TB, 1), lambda i: (i, 0)),
+                  pl.BlockSpec((1, D), lambda i: (0, 0)),
+                  pl.BlockSpec((1, D), lambda i: (0, 0)),
+                  pl.BlockSpec((1, 2), lambda i: (0, 0))],
+        out_specs=[pl.BlockSpec((TB, K), lambda i: (i, 0)),
+                   pl.BlockSpec((TB, K), lambda i: (i, 0)),
+                   pl.BlockSpec((TB, K), lambda i: (i, 0))],
+        out_shape=[jax.ShapeDtypeStruct((B, K), jnp.int32),
+                   jax.ShapeDtypeStruct((B, K), jnp.bool_),
+                   jax.ShapeDtypeStruct((B, K), jnp.bool_)],
+        interpret=interpret,
+    )(win, counts.reshape(B, 1).astype(jnp.int32),
+      skeys.reshape(1, D), svals.reshape(1, D), meta.reshape(1, 2))
+
+
+# -- synth_gather -----------------------------------------------------------
+
+
+def _synth_body(L, CO, R, Tn, LT,
+                ends_ref, starts_ref, sstart_ref, row_ref, ist_ref,
+                tot_ref, rlo_ref, rhi_ref, tlo_ref, thi_ref,
+                lo_ref, hi_ref):
+    ends = ends_ref[...]
+    TB = ends.shape[0]
+    j = jax.lax.broadcasted_iota(jnp.int32, (TB, L), 1)
+    # searchsorted(ends_i, j, 'right') == #{e : ends[e] <= j}: the
+    # compare-count form — CO is small, so one vectorized compare over
+    # the segment axis beats a per-element search on the VPU
+    e = jnp.sum((ends[:, None, :] <= j[:, :, None]).astype(jnp.int32),
+                axis=2)
+    e = jnp.clip(e, 0, CO - 1)
+    onehot = (e[:, :, None] ==
+              jax.lax.broadcasted_iota(jnp.int32, (TB, L, CO), 2)
+              ).astype(jnp.int32)
+
+    def pick(v):   # (TB, CO) per-segment scalar -> its value at e
+        return jnp.sum(onehot * v[:, None, :], axis=2)
+
+    off = pick(sstart_ref[...]) + (j - pick(starts_ref[...]))
+    rsel = pick(row_ref[...])
+    ist = pick(ist_ref[...].astype(jnp.int32)) > 0
+    rc = jnp.clip(rsel, 0, R - 1)
+    rt = jnp.clip(rsel, 0, Tn - 1)
+    # row-table gathers: fancy-indexed loads from the VMEM-resident
+    # banks.  On a physical TPU the corpus bank would ride scalar
+    # prefetch (PrefetchScalarGridSpec) once R*L outgrows VMEM; the
+    # interpret path and small banks take the direct gather.
+    rows_lo = rlo_ref[...]
+    rows_hi = rhi_ref[...]
+    t_lo = tlo_ref[...]
+    t_hi = thi_ref[...]
+    off_r = jnp.clip(off, 0, L - 1)
+    off_t = jnp.clip(off, 0, LT - 1)
+    lo = jnp.where(ist, t_lo[rt, off_t], rows_lo[rc, off_r])
+    hi = jnp.where(ist, t_hi[rt, off_t], rows_hi[rc, off_r])
+    total = tot_ref[...]                     # (TB, 1)
+    eof = jnp.uint32(0xFFFFFFFF)
+    lo_ref[...] = jnp.where(j < total, lo,
+                            jnp.where(j == total, eof, jnp.uint32(0)))
+    hi_ref[...] = jnp.where(j < total, hi,
+                            jnp.where(j == total, eof, jnp.uint32(0)))
+
+
+def synth_gather_pallas(ends, starts, sstart, row, is_t, total,
+                        rows_lo, rows_hi, t_lo, t_hi, *,
+                        interpret: bool = False):
+    """Tiled assembly gather: (TB, CO) program descriptors stream
+    through VMEM while the corpus/template word banks stay resident
+    (constant index_map); segment lookup is the compare-count
+    searchsorted and per-segment scalars resolve through a one-hot
+    select — the (TB, L, CO) one-hot is the VPU-friendly gather for a
+    small CO segment axis."""
+    B, CO = ends.shape
+    R, L = rows_lo.shape
+    Tn, LT = t_lo.shape
+    TB = _tile(B, 8)
+    body = functools.partial(_synth_body, L, CO, R, Tn, LT)
+    desc = pl.BlockSpec((TB, CO), lambda i: (i, 0))
+    lo, hi = pl.pallas_call(
+        body,
+        grid=(B // TB,),
+        in_specs=[desc, desc, desc, desc, desc,
+                  pl.BlockSpec((TB, 1), lambda i: (i, 0)),
+                  pl.BlockSpec((R, L), lambda i: (0, 0)),
+                  pl.BlockSpec((R, L), lambda i: (0, 0)),
+                  pl.BlockSpec((Tn, LT), lambda i: (0, 0)),
+                  pl.BlockSpec((Tn, LT), lambda i: (0, 0))],
+        out_specs=[pl.BlockSpec((TB, L), lambda i: (i, 0)),
+                   pl.BlockSpec((TB, L), lambda i: (i, 0))],
+        out_shape=[jax.ShapeDtypeStruct((B, L), jnp.uint32),
+                   jax.ShapeDtypeStruct((B, L), jnp.uint32)],
+        interpret=interpret,
+    )(ends, starts, sstart, row, is_t,
+      total.reshape(B, 1).astype(jnp.int32),
+      rows_lo, rows_hi, t_lo, t_hi)
+    return lo, hi

@@ -1,0 +1,132 @@
+"""KernelRegistry: one name → (jnp oracle, pallas twin, parity test).
+
+The contract (enforced by the syz-vet `kernel-parity` pass and
+tests/test_kernels.py):
+
+  * every registered kernel has a same-name jnp oracle — the oracle IS
+    the semantics; the pallas twin must be bit-exact against it;
+  * every registration names its parity test so the binding is
+    auditable from the registration site;
+  * `fn(name)` resolves a plane ONCE, at engine build time — plane
+    selection happens at Python closure-build, so the jitted dispatch
+    signature is identical on every plane and a ResilientEngine
+    failover to a standby built with `kernel_plane="jnp"` swaps planes
+    with zero warm recompiles.
+
+Planes:
+  "auto"             — pallas iff the default backend is TPU-like,
+                       jnp otherwise (the CPU/GPU fallback); the
+                       SYZ_KERNEL_PLANE env var overrides.
+  "jnp"              — force the oracle everywhere.
+  "pallas"           — force the pallas twin; on non-TPU backends it
+                       runs in interpret mode (pallas-on-CPU only
+                       executes interpreted), which is exactly how
+                       tier-1 exercises the pallas bodies.
+  "pallas-interpret" — pallas twin, interpret=True unconditionally.
+
+Pallas twins take the oracle's positional signature plus a trailing
+keyword-only `interpret` flag; the registry binds it so callers see
+one signature per name regardless of plane.  Each pallas call runs
+under the dispatch profiler's `subkernel()` scope so a lazy lowering
+compile inside a fused tick is charged to a `dispatch/subkernel`
+child label instead of the outer closure (observe/profile.py).
+"""
+
+from __future__ import annotations
+
+import functools
+import os
+from dataclasses import dataclass
+from typing import Callable
+
+import jax
+
+# backends where the hand-written mosaic kernels are the win; anything
+# else (cpu, gpu, interpreter) takes the jnp oracle or interpret mode
+TPU_BACKENDS = ("tpu",)
+
+PLANES = ("auto", "jnp", "pallas", "pallas-interpret")
+
+
+@dataclass(frozen=True)
+class KernelSpec:
+    name: str
+    oracle: Callable
+    pallas: "Callable | None"
+    parity_test: str
+
+
+def _subkernel_wrap(name: str, fn: Callable) -> Callable:
+    """Charge compiles fired while this kernel runs (eager interpret
+    runs, lazy lowerings) to the active dispatch's subkernel child."""
+    @functools.wraps(fn)
+    def run(*args, **kwargs):
+        from syzkaller_tpu.observe.profile import subkernel
+        with subkernel(name):
+            return fn(*args, **kwargs)
+    return run
+
+
+class KernelRegistry:
+    def __init__(self):
+        self._specs: dict[str, KernelSpec] = {}
+
+    def register(self, name: str, *, oracle: Callable,
+                 pallas: "Callable | None" = None,
+                 parity_test: str = "") -> KernelSpec:
+        """Register a kernel.  `oracle` must be a function literally
+        named `name` — the same-name contract is what lets the vet
+        pass and a reader tie registration, oracle, and parity test
+        together without running anything."""
+        if name in self._specs:
+            raise ValueError(f"kernel {name!r} already registered")
+        if getattr(oracle, "__name__", None) != name:
+            raise ValueError(
+                f"kernel {name!r}: oracle must be a same-name jnp "
+                f"function (got {getattr(oracle, '__name__', oracle)!r})")
+        if pallas is not None and not parity_test:
+            raise ValueError(
+                f"kernel {name!r}: a pallas twin requires a parity_test "
+                "reference (tests/test_kernels.py::...)")
+        spec = KernelSpec(name=name, oracle=oracle, pallas=pallas,
+                          parity_test=parity_test)
+        self._specs[name] = spec
+        return spec
+
+    def names(self) -> list[str]:
+        return sorted(self._specs)
+
+    def spec(self, name: str) -> KernelSpec:
+        return self._specs[name]
+
+    def oracle(self, name: str) -> Callable:
+        return self._specs[name].oracle
+
+    @staticmethod
+    def resolve_plane(plane: str = "auto",
+                      backend: "str | None" = None) -> str:
+        """Collapse "auto" to a concrete plane for `backend` (default:
+        jax.default_backend()).  SYZ_KERNEL_PLANE overrides auto."""
+        if plane == "auto":
+            plane = os.environ.get("SYZ_KERNEL_PLANE", "auto")
+        if plane not in PLANES:
+            raise ValueError(f"unknown kernel plane {plane!r}")
+        if plane == "auto":
+            backend = backend or jax.default_backend()
+            plane = "pallas" if backend in TPU_BACKENDS else "jnp"
+        return plane
+
+    def fn(self, name: str, plane: str = "auto") -> Callable:
+        """Resolve `name` to a callable for `plane`.  Resolution is a
+        build-time decision: the returned callable is closed over by
+        the engine's jitted dispatches, so two engines built with
+        different planes share dispatch signatures (the failover
+        seam's zero-recompile requirement)."""
+        spec = self._specs[name]
+        plane = self.resolve_plane(plane)
+        if plane == "jnp" or spec.pallas is None:
+            return spec.oracle
+        interpret = (plane == "pallas-interpret"
+                     or jax.default_backend() not in TPU_BACKENDS)
+        return _subkernel_wrap(
+            name, functools.partial(spec.pallas, interpret=interpret))

@@ -284,9 +284,23 @@ class AdmissionCoalescer:
             else:
                 live = np.arange(kb)[None, :] < counts[:n, None]
                 mgr.pc_mirror.ensure(win[:n][live])
-                has_new, rows, choices, new_bits = mgr.engine.admit_slabs(
-                    win, counts, call_ids, choice_prev=prev,
-                    mirror=mgr.pc_mirror, with_new_bits=True)
+                # single-dispatch fuzz tick: admission gate + corpus
+                # merge + choice draws PLUS the max-cover signal merge
+                # the replay path would otherwise pay as a separate
+                # dispatch — one host→device crossing per batch.  The
+                # ResilientEngine facade forwards fuzz_tick; older/
+                # minimal engines without it keep the admit_slabs pair.
+                tick = getattr(mgr.engine, "fuzz_tick", None)
+                if tick is not None:
+                    res = tick(win, counts, call_ids, choice_prev=prev,
+                               mirror=mgr.pc_mirror)
+                    has_new, rows = res.has_new, res.rows
+                    choices, new_bits = res.choices, res.new_bits
+                else:
+                    (has_new, rows, choices,
+                     new_bits) = mgr.engine.admit_slabs(
+                        win, counts, call_ids, choice_prev=prev,
+                        mirror=mgr.pc_mirror, with_new_bits=True)
             t_done = time.monotonic()
             ds = mgr.device_stats
             if ds is not None:

@@ -22,12 +22,12 @@ Three parts:
 
 from syzkaller_tpu.observe.console import FleetConsole, HostClient
 from syzkaller_tpu.observe.profile import (
-    DISPATCH_ATTRS, DispatchProfiler, register_slo_gauges)
+    DISPATCH_ATTRS, DispatchProfiler, register_slo_gauges, subkernel)
 from syzkaller_tpu.observe.tsdb import (
     TIERS, DeviceTsdb, HostTsdb, window_width)
 
 __all__ = [
     "DISPATCH_ATTRS", "DeviceTsdb", "DispatchProfiler", "FleetConsole",
     "HostClient", "HostTsdb", "TIERS", "register_slo_gauges",
-    "window_width",
+    "subkernel", "window_width",
 ]

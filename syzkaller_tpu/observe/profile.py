@@ -32,6 +32,7 @@ fleet autopilot consume instead of recomputing ad hoc:
 
 from __future__ import annotations
 
+import contextlib
 import threading
 import time
 
@@ -45,6 +46,7 @@ HIST_BASE = 1e-6
 # every jitted closure cover/engine.py:_build() publishes; attach()
 # skips names a particular engine build doesn't have
 DISPATCH_ATTRS = (
+    "_fuzz_tick_fn",
     "_synth_fn", "_random_bits_fn", "_ingest_update_fn",
     "_ingest_admit_fn", "_ingest_diff_fn", "_ingest_pack_fn",
     "_ingest_pack_or_fn", "_decision_fn", "_popcount_fn", "_pack_fn",
@@ -59,6 +61,27 @@ _COMPILE_EVENT = "backend_compile"
 _reg_mu = threading.Lock()
 _registered = False
 _profilers: "list[DispatchProfiler]" = []
+
+# nested-kernel attribution: the kernel plane enters this scope while a
+# registered pallas twin runs, so a compile fired from INSIDE a fused
+# dispatch (a lazy pallas lowering, an interpret-mode inner jit) lands
+# on a "<dispatch>/<label>" child instead of being charged to the outer
+# closure wholesale — the misattribution that made fused-tick recompile
+# counts unreadable.  Module-global thread-local: one subkernel scope
+# serves every profiler instance on the thread.
+_sub_tls = threading.local()
+
+
+@contextlib.contextmanager
+def subkernel(label: str = "subkernel"):
+    """Attribute compiles in this scope to the active dispatch's
+    `/{label}` child (nests: inner labels win, restored on exit)."""
+    prev = getattr(_sub_tls, "label", None)
+    _sub_tls.label = label
+    try:
+        yield
+    finally:
+        _sub_tls.label = prev
 
 
 def _listener(event: str, duration: float = 0.0, **kwargs) -> None:
@@ -147,6 +170,9 @@ class DispatchProfiler:
 
     def _on_compile(self) -> None:
         name = getattr(self._tls, "name", None) or "other"
+        sub = getattr(_sub_tls, "label", None)
+        if sub:
+            name = f"{name}/{sub}"
         with self._mu:
             self._recompiles[name] = self._recompiles.get(name, 0) + 1
 

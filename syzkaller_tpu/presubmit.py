@@ -308,6 +308,22 @@ print("console ok: %d managers, hub corpus %s"
             f"telemetry overhead {overhead}% out of envelope"
         assert out["extras"]["tsdb_recompiles_warm"] == 0, \
             "tsdb rollup kernel recompiled warm"
+        # kernel-plane acceptance: the fused fuzz tick must stay
+        # bit-exact vs the unfused ingest+admit pair, cross the host
+        # boundary ONCE per batch (counted via /profile/dispatches),
+        # and the dispatch_top table must ride the JSON
+        assert out["extras"]["fuzz_tick_parity"], \
+            "fused fuzz_tick diverged from the unfused pair"
+        fused = out["extras"]["dispatches_per_tick_fused"]
+        unfused = out["extras"]["dispatches_per_tick_unfused"]
+        assert fused == 1, \
+            f"fused fuzz tick is {fused} dispatches/batch, want 1"
+        assert fused < unfused, \
+            f"fusion did not reduce dispatches: {fused} vs {unfused}"
+        top = out["extras"]["dispatch_top"]
+        assert top and all(
+            set(d) == {"name", "calls", "seconds_sum", "recompiles"}
+            for d in top), "malformed dispatch_top table"
 
     total = 0.0
     total += step("description tables", gen_tables)

@@ -143,6 +143,15 @@ class ResilientEngine:
         since = self._degraded_since
         return 0.0 if since is None else time.monotonic() - since
 
+    @property
+    def active_kernel_plane(self) -> str:
+        """Kernel plane of the engine currently serving dispatches
+        (KernelRegistry resolution: "pallas" on the device engine,
+        "jnp" on the CPU fallback).  Consumers watch this across
+        failover/promotion to confirm the plane swapped compile-free
+        with the engine."""
+        return getattr(self._eng, "active_plane", "jnp")
+
     # -- forwarding --------------------------------------------------------
 
     def __getattr__(self, name: str):
@@ -208,7 +217,9 @@ class ResilientEngine:
                 pass            # a concurrent call already failed over
             else:
                 log.logf(0, "backend fault in %s (%s): quarantining "
-                         "device engine, failing over to CPU", name, err)
+                         "device engine (kernel plane %s), failing over "
+                         "to CPU", name, err,
+                         getattr(self._primary, "active_plane", "jnp"))
                 fb = self._fallback
                 if fb is None:
                     fb = self._factory()
@@ -267,7 +278,8 @@ class ResilientEngine:
                 if self._c_promotions is not None:
                     self._c_promotions.inc()
                 log.logf(0, "device backend recovered: promoted back "
-                         "after %.1fs degraded", dur)
+                         "after %.1fs degraded (kernel plane %s)", dur,
+                         getattr(self._primary, "active_plane", "jnp"))
                 promoted = True
         if promoted:
             self._notify_swap()

@@ -83,6 +83,10 @@ SCALAR_SLOTS = [
     ("synth_ring_full", "syz_synth_ring_full_total", {}),
     ("synth_underrun", "syz_synth_underrun_total", {}),
     ("synth_table_rows", "syz_synth_table_rows_total", {}),
+    # single-dispatch fuzz tick: one bump per fused tick (the fused
+    # closure also bumps the dense_/admit_/ingest_ slots its unfused
+    # halves would have, so those series stay comparable either way)
+    ("tick_batches", "syz_fuzz_tick_dispatches_total", {}),
 ]
 
 HIST_SLOTS = [

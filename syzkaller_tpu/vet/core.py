@@ -265,13 +265,14 @@ def dotted(node: ast.AST) -> str:
 
 
 def run_passes(files: list[SourceFile], passes=None) -> Report:
-    """Run the given passes (default: all six) over parsed sources."""
-    from syzkaller_tpu.vet import (hotpath, locks, purity, retrace, schema,
-                                   statslint)
+    """Run the given passes (default: all seven) over parsed sources."""
+    from syzkaller_tpu.vet import (hotpath, kernelparity, locks, purity,
+                                   retrace, schema, statslint)
 
     allp = {"lock": locks.check, "purity": purity.check,
             "retrace": retrace.check, "schema": schema.check,
-            "stats": statslint.check, "hotpath": hotpath.check}
+            "stats": statslint.check, "hotpath": hotpath.check,
+            "kernel-parity": kernelparity.check}
     rep = Report()
     for sf in files:
         if sf.error is not None:

@@ -153,11 +153,73 @@ class _Scanner:
             self.scan(fn, depth + 1)
 
 
+def _pallas_bodies(tree: ast.AST) -> "tuple[set[str], list[ast.Lambda]]":
+    """Names of kernel-body functions handed to `pl.pallas_call` (first
+    positional arg) plus every BlockSpec index_map lambda in the file.
+    Both trace at pallas lowering time — a data-proportional Python
+    loop there re-runs per grid step / per recompile, the exact host
+    work the kernel plane exists to retire."""
+    bodies: set[str] = set()
+    lambdas: list[ast.Lambda] = []
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        d = dotted(node.func)
+        leaf = d.split(".")[-1] if d else ""
+        if leaf == "pallas_call" and node.args \
+                and isinstance(node.args[0], ast.Name):
+            bodies.add(node.args[0].id)
+        elif leaf == "BlockSpec":
+            for a in list(node.args) + [kw.value for kw in node.keywords]:
+                if isinstance(a, ast.Lambda):
+                    lambdas.append(a)
+    return bodies, lambdas
+
+
+def _scan_pallas(sf: SourceFile, findings: list[Finding]) -> None:
+    bodies, lambdas = _pallas_bodies(sf.tree)
+    if not bodies and not lambdas:
+        return
+    funcs = _func_index(sf.tree)
+
+    def flag(node: ast.AST, scope: str, what: str) -> None:
+        findings.append(Finding(
+            pass_name="hotpath", rule="pallas-host-loop", severity=P1,
+            path=sf.path, line=getattr(node, "lineno", 0), scope=scope,
+            message=f"{what} inside a pallas kernel body / index map",
+            hint="pallas bodies trace per compile and index maps per "
+                 "grid step — data-proportional Python iteration there "
+                 "is host work in kernel clothing; use lax.fori_loop "
+                 "with a source-constant trip count or vectorize",
+            detail=f"pallas:{scope}"))
+
+    def scan_nodes(root: ast.AST, scope: str) -> None:
+        for node in ast.walk(root):
+            if isinstance(node, (ast.ListComp, ast.SetComp, ast.DictComp,
+                                 ast.GeneratorExp)):
+                flag(node, scope, "comprehension")
+            elif isinstance(node, ast.While):
+                flag(node, scope, "while-loop")
+            elif isinstance(node, ast.For) \
+                    and not _Scanner._const_iter(node.iter):
+                flag(node, scope, "data-proportional for-loop")
+
+    for name in sorted(bodies):
+        fn = funcs.get(name)
+        if fn is not None:
+            scan_nodes(fn, name)
+    for lam in lambdas:
+        scan_nodes(lam, "index_map")
+
+
 def check(files: list[SourceFile]) -> list[Finding]:
     findings: list[Finding] = []
     for sf in files:
+        if sf.tree is None:
+            continue
+        _scan_pallas(sf, findings)
         roots = _roots_for(sf.path)
-        if not roots or sf.tree is None:
+        if not roots:
             continue
         sc = _Scanner(sf, findings)
         for name in sorted(roots):

@@ -1,0 +1,132 @@
+"""Pass 7 — kernel-parity (pallas plane oracle discipline).
+
+The kernel plane (syzkaller_tpu/kernels/) only stays swappable because
+every pallas kernel has a jnp oracle that IS the semantics: the oracle
+is the CPU/fallback plane, the bit-exactness reference, and the thing
+the fused fuzz tick falls back to on failover.  That contract erodes
+in two silent ways: someone registers a pallas kernel whose `oracle=`
+isn't the same-named jnp function (the name is the lookup key consumers
+resolve through `KERNELS.fn`), or the parity test pinning the two
+bit-exact quietly disappears/never existed.  Both are P0 — an
+unverified pallas kernel is a miscompiled coverage bitmap waiting for
+real TPU hardware.
+
+Rules (scanning every `*.register(...)` call whose receiver name
+mentions KERNEL, e.g. `KERNELS.register`):
+
+  - `kernel-oracle-name` (P0): the `oracle=` argument must be a plain
+    name equal to the registered kernel name — aliased or lambda
+    oracles break `KERNELS.fn(name, "jnp")` semantics and the
+    same-name parity convention.
+  - `kernel-parity-test` (P0): any registration that supplies a
+    `pallas=` twin must supply `parity_test="path::test"` where the
+    path exists under the repo root and the test file's text mentions
+    the kernel name (so the parity test actually exercises it).
+
+Fixture-friendly: file existence is only checked for real repo paths;
+virtual fixture paths (`<fixture>`) skip the filesystem check when the
+referenced test path is absent AND the fixture is virtual.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+
+from syzkaller_tpu.vet.core import (P0, Finding, SourceFile, dotted,
+                                    repo_root)
+
+
+def _registrations(tree: ast.AST):
+    """Yield (call, kernel_name) for KERNEL*-receiver .register calls."""
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        d = dotted(node.func)
+        if not d or not d.endswith(".register"):
+            continue
+        recv = d.rsplit(".", 1)[0]
+        if "kernel" not in recv.lower():
+            continue
+        name = None
+        if node.args and isinstance(node.args[0], ast.Constant) \
+                and isinstance(node.args[0].value, str):
+            name = node.args[0].value
+        else:
+            for kw in node.keywords:
+                if kw.arg == "name" and isinstance(kw.value, ast.Constant):
+                    name = kw.value.value
+        if isinstance(name, str):
+            yield node, name
+
+
+def _kw(call: ast.Call, arg: str) -> "ast.expr | None":
+    for kw in call.keywords:
+        if kw.arg == arg:
+            return kw.value
+    return None
+
+
+def check(files: list[SourceFile]) -> list[Finding]:
+    findings: list[Finding] = []
+    root = repo_root()
+    for sf in files:
+        if sf.tree is None:
+            continue
+        virtual = sf.path.startswith("<")
+        for call, name in _registrations(sf.tree):
+            line = getattr(call, "lineno", 0)
+            oracle = _kw(call, "oracle")
+            if not (isinstance(oracle, ast.Name) and oracle.id == name):
+                findings.append(Finding(
+                    pass_name="kernel-parity", rule="kernel-oracle-name",
+                    severity=P0, path=sf.path, line=line, scope=name,
+                    message=f"kernel {name!r} registered without a "
+                            "same-name jnp oracle "
+                            f"(oracle={ast.unparse(oracle)[:40] if oracle is not None else 'missing'})",
+                    hint="the oracle must be the jnp function literally "
+                         f"named {name!r} — it is the semantics, the "
+                         "CPU plane, and the parity reference",
+                    detail=f"oracle:{name}"))
+            if _kw(call, "pallas") is None:
+                continue
+            pt = _kw(call, "parity_test")
+            ref = pt.value if isinstance(pt, ast.Constant) \
+                and isinstance(pt.value, str) else None
+            if not ref or "::" not in ref:
+                findings.append(Finding(
+                    pass_name="kernel-parity", rule="kernel-parity-test",
+                    severity=P0, path=sf.path, line=line, scope=name,
+                    message=f"pallas kernel {name!r} registered without "
+                            "a parity_test=\"path::test\" reference",
+                    hint="every pallas twin needs a named test proving "
+                         "it bit-exact vs its jnp oracle (interpret "
+                         "mode in tier-1)",
+                    detail=f"parity:{name}"))
+                continue
+            test_path = ref.split("::", 1)[0]
+            full = os.path.join(root, test_path)
+            if not os.path.exists(full):
+                if not virtual:
+                    findings.append(Finding(
+                        pass_name="kernel-parity",
+                        rule="kernel-parity-test", severity=P0,
+                        path=sf.path, line=line, scope=name,
+                        message=f"parity test file {test_path!r} for "
+                                f"kernel {name!r} does not exist",
+                        hint="restore the parity test or drop the "
+                             "pallas twin",
+                        detail=f"parity:{name}"))
+                continue
+            with open(full, encoding="utf-8") as fh:
+                text = fh.read()
+            if name not in text:
+                findings.append(Finding(
+                    pass_name="kernel-parity", rule="kernel-parity-test",
+                    severity=P0, path=sf.path, line=line, scope=name,
+                    message=f"parity test file {test_path!r} never "
+                            f"mentions kernel {name!r}",
+                    hint="the referenced test must actually exercise "
+                         "this kernel against its oracle",
+                    detail=f"parity:{name}"))
+    return findings

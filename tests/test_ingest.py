@@ -369,6 +369,37 @@ def test_admit_slabs_rejects_unresolved_misses():
                         mirror=mirror)
 
 
+def test_submit_tick_fused_matches_slab_path():
+    """DeviceSignal.submit_tick (one fused fuzz-tick dispatch) produces
+    the exact signal verdicts of the submit_slabs path over the same
+    stream — first-sight-key batches included (pre-resolved by ONE
+    mirror.ensure probe, in the same first-seen insertion order as the
+    slab path's deferred fix-up) — and hands the tick's pre-drawn
+    decision draws to the decision_sink."""
+    rng = np.random.default_rng(23)
+    fused, ref = _mk_signal(), _mk_signal()
+    drawn = []
+    for _ in range(10):
+        B, K = 8, 32
+        win = rng.integers(0, 3000, (B, K)).astype(np.uint32)
+        counts = rng.integers(1, K + 1, B).astype(np.int32)
+        cids = rng.integers(0, 16, B).astype(np.int32)
+        ticket, res = fused.submit_tick(
+            win, counts, cids, decision_sink=lambda c: drawn.append(c))
+        got = fused.resolve(ticket)
+        want = ref.resolve(ref.submit_slabs(win, counts, cids))
+        assert np.array_equal(got, want)
+        assert res.fused and res.has_new.shape == (B,)
+    assert len(drawn) == 10 and all(len(c) for c in drawn)
+    # same max-cover frontier, same first-seen key order (PR 9 contract)
+    assert np.array_equal(np.asarray(fused.engine.max_cover),
+                          np.asarray(ref.engine.max_cover))
+    assert np.array_equal(fused.pcmap.export_keys(),
+                          ref.pcmap.export_keys())
+    # the fused tick bumped its own dispatch series inside the kernel
+    assert fused.tstats.snapshot()["syz_fuzz_tick_dispatches_total"] >= 10
+
+
 def test_ingest_telemetry_series_present():
     sig = _mk_signal()
     sig.check_batch([(1, np.arange(50, 90, dtype=np.uint32))])

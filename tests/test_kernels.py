@@ -1,0 +1,321 @@
+"""Pallas kernel plane: every registered pallas twin bit-exact vs its
+jnp oracle (interpret mode — how tier-1 exercises pallas bodies on
+CPU), the fused fuzz tick bit-exact vs the unfused
+ingest_update_slabs + admit_slabs pair, and zero warm recompiles for
+the fused tick across 1k mixed-size batches AND a ResilientEngine
+failover/promotion cycle (the kernel-plane swap is a build-time
+decision, so dispatch signatures never change)."""
+
+import numpy as np
+import pytest
+
+from syzkaller_tpu.fuzzer.pcmap import DeviceKeyMirror, PcMap
+from syzkaller_tpu.kernels import KERNELS
+
+pytestmark = pytest.mark.filterwarnings("ignore")
+
+
+def _pallas(name):
+    """The registered pallas twin, forced to interpret mode."""
+    import functools
+
+    spec = KERNELS.spec(name)
+    return functools.partial(spec.pallas, interpret=True)
+
+
+# -- registry contract -------------------------------------------------------
+
+
+def test_registry_contract():
+    assert KERNELS.names() == ["signal_diff", "synth_gather",
+                               "translate_slab_rows"]
+    for name in KERNELS.names():
+        spec = KERNELS.spec(name)
+        assert spec.oracle.__name__ == name
+        assert spec.pallas is not None
+        assert spec.parity_test.startswith("tests/test_kernels.py::")
+    # plane resolution: CPU backend → jnp on auto; forced planes stick
+    assert KERNELS.resolve_plane("auto", backend="cpu") == "jnp"
+    assert KERNELS.resolve_plane("auto", backend="tpu") == "pallas"
+    assert KERNELS.resolve_plane("pallas-interpret") == "pallas-interpret"
+    with pytest.raises(ValueError):
+        KERNELS.resolve_plane("mosaic")
+
+
+def test_registry_same_name_oracle_enforced():
+    from syzkaller_tpu.kernels.registry import KernelRegistry
+
+    reg = KernelRegistry()
+
+    def right_name(x):
+        return x
+
+    with pytest.raises(ValueError, match="same-name"):
+        reg.register("wrong_name", oracle=right_name)
+    with pytest.raises(ValueError, match="parity_test"):
+        reg.register("right_name", oracle=right_name,
+                     pallas=lambda x, *, interpret=False: x)
+
+
+# -- per-kernel parity (randomized shapes, every pow2 bucket) ---------------
+
+
+def test_signal_diff_parity():
+    oracle, pallas = KERNELS.oracle("signal_diff"), _pallas("signal_diff")
+    rng = np.random.default_rng(0)
+    for B in (1, 2, 8, 64, 256):
+        for W in (64, 128, 512, 1024):
+            prev = rng.integers(0, 2**32, (B, W)).astype(np.uint32)
+            bm = rng.integers(0, 2**32, (B, W)).astype(np.uint32)
+            # some rows with nothing new at all
+            bm[:: 3] = prev[:: 3]
+            wn, wh, wc = oracle(prev, bm)
+            gn, gh, gc = pallas(prev, bm)
+            assert np.array_equal(np.asarray(wn), np.asarray(gn)), (B, W)
+            assert np.array_equal(np.asarray(wh), np.asarray(gh)), (B, W)
+            assert np.array_equal(np.asarray(wc), np.asarray(gc)), (B, W)
+
+
+def test_translate_slab_rows_parity():
+    oracle = KERNELS.oracle("translate_slab_rows")
+    pallas = _pallas("translate_slab_rows")
+    rng = np.random.default_rng(1)
+    D, direct_cap, overflow = 512, 3072, 1024
+    keys = np.sort(rng.choice(2**31, D - 64, replace=False)
+                   ).astype(np.uint32)
+    skeys = np.full((D,), 0xFFFFFFFF, np.uint32)
+    skeys[: len(keys)] = keys
+    svals = np.arange(D, dtype=np.int32)
+    for B in (1, 4, 32, 128):
+        for K in (8, 64, 256):
+            # half known keys, half unknown — both meta states
+            win = np.where(rng.random((B, K)) < 0.5,
+                           keys[rng.integers(0, len(keys), (B, K))],
+                           rng.integers(2**31, 2**32, (B, K))
+                           ).astype(np.uint32)
+            counts = rng.integers(0, K + 1, B).astype(np.int32)
+            for full in (0, 1):
+                meta = np.array([len(keys), full], np.int32)
+                want = oracle(win, counts, skeys, svals, meta,
+                              direct_cap, overflow)
+                got = pallas(win, counts, skeys, svals, meta,
+                             direct_cap, overflow)
+                for w, g in zip(want, got):
+                    assert np.array_equal(np.asarray(w),
+                                          np.asarray(g)), (B, K, full)
+
+
+def test_synth_gather_parity():
+    oracle = KERNELS.oracle("synth_gather")
+    pallas = _pallas("synth_gather")
+    rng = np.random.default_rng(2)
+    for B, CO, L in ((1, 4, 32), (8, 8, 64), (16, 4, 128)):
+        R, Tn, LT = 32, 8, L
+        rows_lo = rng.integers(0, 2**32, (R, L)).astype(np.uint32)
+        rows_hi = rng.integers(0, 2**32, (R, L)).astype(np.uint32)
+        t_lo = rng.integers(0, 2**32, (Tn, LT)).astype(np.uint32)
+        t_hi = rng.integers(0, 2**32, (Tn, LT)).astype(np.uint32)
+        # build nondecreasing segment bounds
+        seg = rng.integers(0, L // CO + 1, (B, CO)).astype(np.int32)
+        ends = np.cumsum(seg, axis=1).astype(np.int32)
+        starts = np.concatenate(
+            [np.zeros((B, 1), np.int32), ends[:, :-1]], axis=1)
+        sstart = rng.integers(0, L // 2, (B, CO)).astype(np.int32)
+        row = rng.integers(0, R, (B, CO)).astype(np.int32)
+        is_t = rng.random((B, CO)) < 0.3
+        total = np.minimum(ends[:, -1], L - 1).astype(np.int32)
+        import jax.numpy as jnp
+
+        args = tuple(jnp.asarray(a) for a in (
+            ends, starts, sstart, row, is_t, total,
+            rows_lo, rows_hi, t_lo, t_hi))
+        wl, wh = oracle(*args)
+        gl, gh = pallas(*args)
+        assert np.array_equal(np.asarray(wl), np.asarray(gl)), (B, CO, L)
+        assert np.array_equal(np.asarray(wh), np.asarray(gh)), (B, CO, L)
+
+
+# -- fused fuzz tick ---------------------------------------------------------
+
+
+def _mk_engine(plane="auto", cap=256):
+    from syzkaller_tpu.cover.engine import CoverageEngine
+
+    return CoverageEngine(npcs=1 << 12, ncalls=16, corpus_cap=cap,
+                          kernel_plane=plane)
+
+
+def _mk_mirror(eng, nkeys=3000):
+    pm = PcMap(1 << 12)
+    pm.preseed(np.arange(0, nkeys, dtype=np.uint64))
+    mirror = DeviceKeyMirror(pm, put=eng.put_replicated)
+    mirror.refresh()
+    return mirror
+
+
+def _slab_stream(rng, n, Bs=(1, 2, 4, 8), Ks=(8, 16, 32, 64),
+                 nkeys=3000):
+    out = []
+    for _ in range(n):
+        B = int(Bs[int(rng.integers(len(Bs)))])
+        K = int(Ks[int(rng.integers(len(Ks)))])
+        win = rng.integers(0, nkeys, (B, K)).astype(np.uint32)
+        counts = rng.integers(1, K + 1, B).astype(np.int32)
+        cids = rng.integers(0, 16, B).astype(np.int32)
+        prev = rng.integers(-1, 16, B).astype(np.int32)
+        out.append((win, counts, cids, prev))
+    return out
+
+
+def test_fuzz_tick_bit_exact_vs_unfused_pair():
+    """engine.fuzz_tick ≡ ingest_update_slabs followed by admit_slabs:
+    identical verdicts, rows, new-bit counts, AND identical final
+    max/corpus cover + signal matrix.  A third engine on the forced
+    pallas-interpret plane matches too."""
+    rng = np.random.default_rng(5)
+    stream = _slab_stream(rng, 12)
+
+    fused, unfused = _mk_engine(), _mk_engine()
+    forced = _mk_engine("pallas-interpret")
+    mf, mu, mp = (_mk_mirror(e) for e in (fused, unfused, forced))
+    for win, counts, cids, prev in stream:
+        res = fused.fuzz_tick(win, counts, cids, prev, mf)
+        assert res.fused
+
+        unfused.ingest_update_slabs(win, counts, cids, mu)
+        hn, rows, _ch, nbits = unfused.admit_slabs(
+            win, counts, cids, prev, mu, with_new_bits=True)
+        assert np.array_equal(res.has_new, hn)
+        assert np.array_equal(res.rows, rows)
+        assert np.array_equal(res.new_bits, np.asarray(nbits))
+
+        resp = forced.fuzz_tick(win, counts, cids, prev, mp)
+        assert np.array_equal(res.has_new, resp.has_new)
+        assert np.array_equal(res.new_bits, resp.new_bits)
+
+    for a in (unfused, forced):
+        assert np.array_equal(np.asarray(fused.max_cover),
+                              np.asarray(a.max_cover))
+        assert np.array_equal(np.asarray(fused.corpus_cover),
+                              np.asarray(a.corpus_cover))
+        assert np.array_equal(np.asarray(fused.corpus_mat),
+                              np.asarray(a.corpus_mat))
+        assert fused.corpus_len == a.corpus_len
+
+
+def test_fuzz_tick_zero_warm_recompiles_1k_mixed_batches():
+    """The fused tick dispatch compiles NOTHING once the pow2 × pow2
+    shape closure is warm — 1k mixed-size batches, one dispatch each."""
+    from syzkaller_tpu.vet.runtime import CompileCounter
+
+    # cap high enough that the corpus never saturates mid-loop — the
+    # cap fallback is the unfused pair, whose own shapes compile once
+    eng = _mk_engine(cap=8192)
+    mirror = _mk_mirror(eng)
+    rng = np.random.default_rng(7)
+    Bs, Ks = (1, 2, 4, 8), (8, 16, 32, 64)
+    for B in Bs:                            # warm the closure
+        for K in Ks:
+            win, counts, cids, prev = _slab_stream(
+                rng, 1, Bs=(B,), Ks=(K,))[0]
+            eng.fuzz_tick(win, counts, cids, prev, mirror)
+    with CompileCounter() as cc:
+        for win, counts, cids, prev in _slab_stream(rng, 1000,
+                                                    Bs=Bs, Ks=Ks):
+            eng.fuzz_tick(win, counts, cids, prev, mirror)
+    assert cc.count == 0, f"{cc.count} warm recompiles"
+
+
+def test_fuzz_tick_zero_recompiles_across_failover_cycle():
+    """Mid-storm failover: the CPU fallback engine (jnp plane) takes
+    over compile-free once its own closure is warm, no admitted input
+    is lost, and promotion back to the primary is also compile-free —
+    the KernelRegistry plane swap never changes a dispatch signature."""
+    from syzkaller_tpu.resilience import ResilientEngine
+    from syzkaller_tpu.vet.runtime import CompileCounter
+
+    primary = _mk_engine()
+    eng = ResilientEngine(primary, lambda: _mk_engine("jnp"),
+                          probe_interval=0.0)
+    mirror = _mk_mirror(eng)
+    rng = np.random.default_rng(9)
+    # one dispatch shape: the pin is about the PLANE swap, so keep the
+    # pow2 shape closure out of the picture
+    Bs, Ks = (4,), (16,)
+    warm = _slab_stream(rng, 8, Bs=Bs, Ks=Ks)
+    admitted = 0
+    for win, counts, cids, prev in warm:
+        res = eng.fuzz_tick(win, counts, cids, prev, mirror)
+        admitted += int(res.has_new.sum())
+    primary.random_words(64)               # warm the probe's dispatch
+    assert eng.active_kernel_plane == primary.active_plane
+
+    eng.injector.arm()
+    storm = _slab_stream(rng, 8, Bs=Bs, Ks=Ks)
+    res = eng.fuzz_tick(*storm[0][:3], storm[0][3], mirror)
+    admitted += int(res.has_new.sum())     # the faulted call retried
+    assert eng.degraded and eng.injector.fired >= 1
+    assert eng.active_kernel_plane == "jnp"
+    for win, counts, cids, prev in storm[1:4]:   # warm fallback shapes
+        admitted += int(eng.fuzz_tick(win, counts, cids, prev,
+                                      mirror).has_new.sum())
+    eng.injector.disarm()
+    with CompileCounter() as cc:
+        for win, counts, cids, prev in storm[4:6]:   # warm fallback
+            admitted += int(eng.fuzz_tick(win, counts, cids, prev,
+                                          mirror).has_new.sum())
+        assert eng.probe() is True         # → promoted back
+        for win, counts, cids, prev in storm[6:]:    # warm primary
+            admitted += int(eng.fuzz_tick(win, counts, cids, prev,
+                                          mirror).has_new.sum())
+    assert cc.count == 0, f"{cc.count} recompiles across failover cycle"
+    assert not eng.degraded
+    assert eng.corpus_len == admitted      # zero admitted-input loss
+
+
+def test_fuzz_tick_corpus_cap_fallback_matches_admit_slabs():
+    """When the matrix cannot take the batch, fuzz_tick degrades to the
+    unfused pair with identical gate-only verdicts (fused=False)."""
+    from syzkaller_tpu.cover.engine import CoverageEngine
+
+    eng = CoverageEngine(npcs=1 << 12, ncalls=16, corpus_cap=4)
+    ref = CoverageEngine(npcs=1 << 12, ncalls=16, corpus_cap=4)
+    me, mr = _mk_mirror(eng), _mk_mirror(ref)
+    rng = np.random.default_rng(13)
+    for win, counts, cids, prev in _slab_stream(rng, 6, Bs=(4,),
+                                                Ks=(16,)):
+        res = eng.fuzz_tick(win, counts, cids, prev, me)
+        ref.ingest_update_slabs(win, counts, cids, mr)
+        hn, rows, _c, nb = ref.admit_slabs(win, counts, cids, prev, mr,
+                                           with_new_bits=True)
+        assert np.array_equal(res.has_new, hn)
+        assert np.array_equal(res.new_bits, np.asarray(nb))
+        assert (res.rows is None) == (rows is None)
+        if rows is None:
+            assert not res.fused
+    assert eng.corpus_len == ref.corpus_len
+
+
+def test_decision_stream_feed_banks_tick_draws():
+    """DecisionStream.feed banks a tick's ride-along draws under ring
+    caps, and a stale epoch (post-invalidate) discards them."""
+    from syzkaller_tpu.fuzzer.device_ct import DecisionStream
+
+    eng = _mk_engine()
+    win = np.arange(64, dtype=np.uint32).reshape(4, 16)
+    eng.fuzz_tick(win, np.full(4, 16, np.int32),
+                  np.arange(4, dtype=np.int32),
+                  np.full(4, -1, np.int32), _mk_mirror(eng))
+    stream = DecisionStream(eng, per_row=8, hot_slots=8, corpus_rows=16,
+                            entropy_words=256, autostart=False)
+    draws = np.arange(6, dtype=np.int64) % 16
+    got = stream.feed(-1, draws, epoch=stream.epoch())
+    assert got == len(draws)
+    assert stream.take(-1, got) == list(draws[:got])
+    # a stale epoch discards instead of publishing
+    ep = stream.epoch()
+    stream.invalidate()
+    before = stream.stat_discarded
+    assert stream.feed(-1, draws, epoch=ep) == 0
+    assert stream.stat_discarded == before + 1
+    stream.stop()

@@ -215,6 +215,35 @@ def test_dispatch_profiler_recompile_attribution():
     assert prof.snapshot()["dispatches"]["e"]["count"] == 1
 
 
+def test_dispatch_profiler_subkernel_child_attribution():
+    """The PR-16 nested-compile fix: a compile fired inside a
+    `subkernel()` scope (a registry-wrapped pallas kernel lowering
+    inside a fused tick) is charged to a `dispatch/label` CHILD, not
+    misattributed to the outer closure — and the outer dispatch keeps
+    its own direct compiles."""
+    from syzkaller_tpu.observe import subkernel
+
+    prof = DispatchProfiler()
+
+    def body():
+        prof._on_compile()              # outer closure's own compile
+        with subkernel("signal_diff"):
+            prof._on_compile()          # nested kernel lowering
+            with subkernel("inner"):    # scopes nest + restore
+                prof._on_compile()
+        prof._on_compile()
+
+    prof.wrap("fuzz_tick", body)()
+    snap = prof.snapshot()
+    assert snap["recompiles"]["fuzz_tick"] == 2
+    assert snap["recompiles"]["fuzz_tick/signal_diff"] == 1
+    assert snap["recompiles"]["fuzz_tick/inner"] == 1
+    # outside any dispatch, a subkernel compile still gets the child tag
+    with subkernel("stray"):
+        prof._on_compile()
+    assert prof.snapshot()["recompiles"]["other/stray"] == 1
+
+
 def _raise():
     raise ValueError("boom")
 

@@ -776,6 +776,129 @@ def test_hotpath_real_tree_remnants_all_baselined():
     assert not loose, "\n".join(f.render() for f in loose)
 
 
+# -- pass 7: kernel-parity ---------------------------------------------------
+
+
+KP_SEEDED = """
+def other_fn(x):
+    return x
+
+KERNELS = object()
+KERNELS.register("signal_diff", oracle=other_fn,
+                 pallas=other_fn,
+                 parity_test="tests/no_such_file.py::test_x")
+KERNELS.register("no_parity", oracle=other_fn, pallas=other_fn)
+"""
+
+KP_CLEAN = """
+def my_kernel(x):
+    return x
+
+def my_kernel_pallas(x, *, interpret=False):
+    return x
+
+def plain_oracle_only(x):
+    return x
+
+KERNELS = object()
+KERNELS.register("my_kernel", oracle=my_kernel,
+                 pallas=my_kernel_pallas,
+                 parity_test="tests/test_kernels.py::test_x")
+KERNELS.register("plain_oracle_only", oracle=plain_oracle_only)
+"""
+
+
+def test_kernel_parity_seeded_violations_caught():
+    f = run(KP_SEEDED, ["kernel-parity"])
+    assert "kernel-oracle-name" in rules(f)
+    assert "kernel-parity-test" in rules(f)
+    assert all(x.severity == "P0" for x in f)
+    # no_parity: missing parity_test entirely; plain oracle mismatch
+    assert len(f) >= 3
+
+
+def test_kernel_parity_clean_registration_quiet():
+    # parity_test points at the real tests/test_kernels.py; the only
+    # finding a clean-shaped fixture can trip is the "file never
+    # mentions the kernel" rule — my_kernel isn't a real kernel name
+    f = run(KP_CLEAN, ["kernel-parity"])
+    assert rules(f) <= {"kernel-parity-test"}
+    good = KP_CLEAN.replace("my_kernel", "signal_diff")
+    assert run(good, ["kernel-parity"]) == []
+
+
+def test_kernel_parity_ignores_non_kernel_registries():
+    src = """
+def handler(x):
+    return x
+
+ROUTES = object()
+ROUTES.register("get", oracle=handler)
+"""
+    assert run(src, ["kernel-parity"]) == []
+
+
+def test_kernel_parity_real_tree_zero_p0():
+    """Every registered kernel on the real tree has its same-name
+    oracle and a live parity test — the acceptance bar."""
+    rep = vet.run_repo()
+    kp = [f for f in rep.findings if f.pass_name == "kernel-parity"]
+    assert not kp, "\n".join(f.render() for f in kp)
+
+
+# -- hotpath: pallas-host-loop -----------------------------------------------
+
+
+PALLAS_SEEDED = """
+import jax.experimental.pallas as pl
+
+def _body(x_ref, o_ref):
+    acc = 0
+    for w in range(x_ref.shape[0]):
+        acc = acc + x_ref[w]
+    o_ref[...] = acc
+
+def kernel(x, n):
+    return pl.pallas_call(
+        _body,
+        grid=(n,),
+        in_specs=[pl.BlockSpec((8, 8), lambda i: (i, [j for j in (0,)][0]))],
+    )(x)
+"""
+
+PALLAS_CLEAN = """
+import jax.experimental.pallas as pl
+from jax import lax
+
+def _body(x_ref, o_ref):
+    def step(k, acc):
+        return acc + x_ref[k]
+    o_ref[...] = lax.fori_loop(0, 4, step, 0)
+    for _ in range(3):
+        pass
+
+def kernel(x, n):
+    return pl.pallas_call(
+        _body,
+        grid=(n,),
+        in_specs=[pl.BlockSpec((8, 8), lambda i: (i, 0))],
+    )(x)
+"""
+
+
+def test_pallas_host_loop_caught_anywhere():
+    # fires regardless of path — kernel bodies are hot by definition
+    f = run(PALLAS_SEEDED, ["hotpath"], path="somewhere/else.py")
+    assert rules(f) == {"pallas-host-loop"}
+    scopes = {x.scope for x in f}
+    assert "_body" in scopes and "index_map" in scopes
+
+
+def test_pallas_clean_body_quiet():
+    # lax.fori_loop + constant-trip retry loops are fine
+    assert run(PALLAS_CLEAN, ["hotpath"], path="somewhere/else.py") == []
+
+
 # -- the gate itself --------------------------------------------------------
 
 
@@ -800,7 +923,8 @@ def test_vet_cli_json(capsys):
     assert rep["ok"] is True
     assert rep["counts"]["p0_unbaselined"] == 0
     assert set(rep["counts"]["by_pass"]) <= {
-        "lock", "purity", "retrace", "schema", "stats", "hotpath"}
+        "lock", "purity", "retrace", "schema", "stats", "hotpath",
+        "kernel-parity"}
 
 
 def test_parse_error_blocks_gate(tmp_path):
