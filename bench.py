@@ -1377,6 +1377,63 @@ def bench_fuzz_tick(smoke=False):
     }
 
 
+def bench_san_overhead(smoke=False):
+    """syz-san runtime-plane cost: the same fused fuzz-tick loop run
+    unarmed and then under SYZ_SAN=1 (shadow checker wrapped around
+    every dispatch closure + donation poison sweep).  Reported as
+    `san_overhead_pct` so the sanitizer's tax is visible in every
+    BENCH_*.json — the opt-in only stays cheap if drift is measured."""
+    import os
+
+    from syzkaller_tpu import san
+    from syzkaller_tpu.cover.engine import CoverageEngine
+    from syzkaller_tpu.fuzzer.pcmap import DeviceKeyMirror, PcMap
+
+    npcs, nkeys = 1 << 12, 3000
+    n = 48 if smoke else 384
+    rng = np.random.default_rng(23)
+    batches = []
+    for _ in range(n):
+        win = rng.integers(0, nkeys, (8, 32)).astype(np.uint32)
+        counts = rng.integers(1, 33, 8).astype(np.int32)
+        cids = rng.integers(0, 16, 8).astype(np.int32)
+        prev = rng.integers(-1, 16, 8).astype(np.int32)
+        batches.append((win, counts, cids, prev))
+
+    def run(armed: bool) -> float:
+        prev_env = os.environ.get("SYZ_SAN")
+        os.environ["SYZ_SAN"] = "1" if armed else "0"
+        try:
+            eng = CoverageEngine(npcs=npcs, ncalls=16, corpus_cap=4096)
+            pm = PcMap(npcs)
+            pm.preseed(np.arange(0, nkeys, dtype=np.uint64))
+            mirror = DeviceKeyMirror(pm, put=eng.put_replicated)
+            mirror.refresh()
+            if armed:
+                san.attach(eng)     # idempotent with _build's self-arm
+            w, c, ci, pv = batches[0]
+            eng.fuzz_tick(w, c, ci, pv, mirror)       # warm the closure
+            t0 = time.perf_counter()
+            for w, c, ci, pv in batches[1:]:
+                eng.fuzz_tick(w, c, ci, pv, mirror)
+            return time.perf_counter() - t0
+        finally:
+            if prev_env is None:
+                os.environ.pop("SYZ_SAN", None)
+            else:
+                os.environ["SYZ_SAN"] = prev_env
+
+    plain_dt = run(armed=False)
+    armed_dt = run(armed=True)
+    findings = san.report.total
+    return {
+        "san_overhead_pct": round(
+            (armed_dt - plain_dt) / plain_dt * 100.0, 1),
+        "san_armed_batches_per_sec": round((n - 1) / armed_dt, 1),
+        "san_findings_clean_run": findings,
+    }
+
+
 def main(argv=None):
     ap = argparse.ArgumentParser()
     ap.add_argument("--smoke", action="store_true",
@@ -1473,6 +1530,8 @@ def main(argv=None):
                                         nexecs=(8 if args.smoke else 16) * B))
     _stage("fused fuzz tick (single dispatch)")
     extras.update(bench_fuzz_tick(smoke=args.smoke))
+    _stage("syz-san overhead (runtime sanitizer)")
+    extras.update(bench_san_overhead(smoke=args.smoke))
     _stage("corpus scale")
     extras.update(bench_corpus_scale(np.random.default_rng(13),
                                      C=2048 if args.smoke else 100_000))

@@ -1572,6 +1572,13 @@ class CoverageEngine:
         self._sample_fn = _sample
         self._prio_update_fn = _prio_update
 
+        # syz-san: under SYZ_SAN=1 every rebuilt closure set re-arms the
+        # shadow checker (attach is idempotent and composes with the
+        # dispatch profiler); unarmed this is one falsy branch
+        from syzkaller_tpu import san as _san
+        if _san.armed():
+            _san.attach(self)
+
     # -- public ops ------------------------------------------------------
 
     def _fit(self, call_ids, pc_idx, valid):

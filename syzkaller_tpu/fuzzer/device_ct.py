@@ -39,6 +39,7 @@ from collections import deque
 
 import numpy as np
 
+from syzkaller_tpu import san as _san
 from syzkaller_tpu.utils import log
 from syzkaller_tpu.utils.shapes import pow2_bucket
 
@@ -114,6 +115,10 @@ class DecisionStream:
         self._kicked = False
         self._stop = False
         self._inflight = None
+        if _san.armed():
+            # syz-san: _mu must never be held across device work — the
+            # lockset audit turns a violation into a hard error
+            _san.audit_lock(self, "_mu", "decision_stream._mu")
         self._thread: "threading.Thread | None" = None
         if autostart:
             self._thread = threading.Thread(
@@ -371,8 +376,10 @@ class DecisionStream:
             blk = self.engine.decision_block(
                 hot_dev, self.per_row, self.n_rows, self.n_entropy,
                 overlay=overlay)
+            tok = _san.stamp(hot_host, "decision hot_host") \
+                if _san.armed() else None
             prev, self._inflight = self._inflight, (
-                epoch, time.monotonic(), hot_host, blk)
+                epoch, time.monotonic(), hot_host, blk, tok)
             self._publish(prev)
         prev, self._inflight = self._inflight, None
         self._publish(prev)
@@ -380,7 +387,10 @@ class DecisionStream:
     def _publish(self, inflight) -> None:
         if inflight is None:
             return
-        epoch, t0, hot_host, blk = inflight
+        epoch, t0, hot_host, blk, tok = inflight
+        # syz-san: the hot composition handed to the dispatch must not
+        # have mutated while the block was in flight
+        _san.verify(tok)
         # the host syncs — outside every lock
         base = np.asarray(blk.base)
         hot = np.asarray(blk.hot)
@@ -490,7 +500,9 @@ class DecisionStream:
         blk = self.engine.decision_block(
             hot_dev, self.per_row, self.n_rows, self.n_entropy,
             overlay=overlay)
-        self._publish((epoch, time.monotonic(), hot_host, blk))
+        tok = _san.stamp(hot_host, "decision hot_host") \
+            if _san.armed() else None
+        self._publish((epoch, time.monotonic(), hot_host, blk, tok))
 
     def inventory(self) -> int:
         with self._mu:

@@ -38,6 +38,7 @@ import numpy as np
 
 import operator
 
+from syzkaller_tpu import san as _san
 from syzkaller_tpu.cover import sets
 from syzkaller_tpu.fuzzer.pcmap import DeviceKeyMirror, PcMap, _dedup_rows
 from syzkaller_tpu.utils import log
@@ -46,6 +47,22 @@ from syzkaller_tpu.utils.shapes import pow2_bucket
 
 def _u32cover(c) -> np.ndarray:
     return np.asarray(c, np.uint32).ravel()
+
+
+def _stamp_slab(win, counts, what: str):
+    """syz-san generation stamps for the host buffers a pipelined
+    ticket keeps referencing while its dispatch is in flight (None
+    when unarmed — the unarmed cost is one branch)."""
+    if not _san.armed():
+        return None
+    return (_san.stamp(win, f"{what} win"),
+            _san.stamp(counts, f"{what} counts"))
+
+
+def _verify_slab(toks) -> None:
+    if toks is not None:
+        _san.verify(toks[0])
+        _san.verify(toks[1])
 
 
 class DeviceSignal:
@@ -164,10 +181,12 @@ class DeviceSignal:
                                               self.mirror)
         self.stat_ingest_dispatches += 1
         return ("slab", res, win, counts, np.asarray(call_ids, np.int32),
-                self._frontier, time.monotonic())
+                self._frontier, time.monotonic(),
+                _stamp_slab(win, counts, "slab"))
 
     def _resolve_slab(self, ticket) -> np.ndarray:
-        _kind, res, win, counts, call_ids, frontier, t0 = ticket
+        _kind, res, win, counts, call_ids, frontier, t0, toks = ticket
+        _verify_slab(toks)
         has_new = np.asarray(res.has_new)            # the host sync
         miss = np.asarray(res.miss_rows)
         if miss.any():
@@ -182,7 +201,8 @@ class DeviceSignal:
 
     def submit_tick(self, win: np.ndarray, counts: np.ndarray,
                     call_ids: np.ndarray, choice_prev=None,
-                    corpus_indices=None, decision_sink=None):
+                    corpus_indices=None, decision_sink=None,
+                    decision_epoch=None):
         """ONE whole fuzz tick for a slab window: signal diff/merge +
         admission gate/corpus merge + pre-drawn decision draws in a
         single host→device dispatch (engine.fuzz_tick) — the fused
@@ -198,7 +218,11 @@ class DeviceSignal:
         changing the admitted set.  `corpus_indices` (per slab row)
         feeds the device-row→corpus map for admitted rows;
         `decision_sink` (e.g. DecisionStream.feed bound to a prev
-        context) receives the tick's pre-drawn next-call ids.
+        context) receives the tick's pre-drawn next-call ids; pass
+        `decision_epoch` (the stream's epoch(), snapshotted BEFORE this
+        call) so a stream invalidation racing the tick discards the
+        stale draws instead of banking them (syz-vet
+        epoch/feed-missing-epoch).
 
         Returns (ticket, FuzzTickResult)."""
         win = np.asarray(win)
@@ -221,13 +245,17 @@ class DeviceSignal:
         elif res.rows is None:
             self.stat_corpus_full += 1
         if decision_sink is not None:
-            decision_sink(res.choices)
+            if decision_epoch is not None:
+                decision_sink(res.choices, epoch=decision_epoch)
+            else:
+                decision_sink(res.choices)
         ticket = ("tick", res, win, counts, call_ids, self._frontier,
-                  time.monotonic())
+                  time.monotonic(), _stamp_slab(win, counts, "tick"))
         return ticket, res
 
     def _resolve_tick(self, ticket) -> np.ndarray:
-        _kind, res, _win, counts, call_ids, frontier, t0 = ticket
+        _kind, res, _win, counts, call_ids, frontier, t0, toks = ticket
+        _verify_slab(toks)
         has_new = np.asarray(res.sig_has_new)        # the host sync
         if frontier is not None:
             frontier.absorb(call_ids, res.signal_view())

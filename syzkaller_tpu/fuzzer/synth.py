@@ -212,17 +212,25 @@ class DeviceSynth:
     # -- dispatch --------------------------------------------------------
 
     def dispatch(self, overlay=None):
-        """One async synth_block dispatch; returns an opaque ticket."""
+        """One async synth_block dispatch; returns an opaque ticket.
+        The ticket freezes EVERY table the resolve reads — rows, the
+        template bank, and the call→template map — as of submit time
+        (syz-vet epoch/resolve-reads-live-table: a template admitted
+        between submit and resolve must not re-map this block's
+        provenance)."""
         blk = self.engine.synth_block(self.operands(), self.B,
                                       self.GMAX, overlay=overlay)
-        return (blk, self.snapshot(), time.monotonic())
+        with self._mu:
+            snap = (tuple(self._rows), tuple(self._tmpls),
+                    self._h["call2tmpl"].copy())
+        return (blk, snap, time.monotonic())
 
     def resolve(self, ticket) -> "SynthBatch":
         """Fetch one dispatched block: B ready programs as one slab
         matrix plus per-program provenance views (call ids and Prog
         factories derive lazily from provenance + the submit-time
         table snapshot)."""
-        blk, (rows, tmpls), t0 = ticket
+        blk, (rows, tmpls, c2t), t0 = ticket
         out32 = np.asarray(blk.out32)
         lens32 = np.asarray(blk.lens32)
         op = np.asarray(blk.op)
@@ -242,7 +250,6 @@ class DeviceSynth:
         if self.tstats is not None:
             self.tstats.observe("synth_block_consume_latency",
                                 time.monotonic() - t0)
-        c2t = self._h["call2tmpl"]
         gen_tmpls = np.maximum(c2t[gen_cids], 0)
         ins_tmpl = np.maximum(c2t[ins_cid], 0)
         progs = []

@@ -58,10 +58,15 @@ class KernelSpec:
 
 def _subkernel_wrap(name: str, fn: Callable) -> Callable:
     """Charge compiles fired while this kernel runs (eager interpret
-    runs, lazy lowerings) to the active dispatch's subkernel child."""
+    runs, lazy lowerings) to the active dispatch's subkernel child;
+    under SYZ_SAN=1 also refuse poisoned (donated, never-rebound)
+    operands before they reach a fused lowering."""
     @functools.wraps(fn)
     def run(*args, **kwargs):
+        from syzkaller_tpu import san
         from syzkaller_tpu.observe.profile import subkernel
+        if san.armed():
+            san.check_operands(args, dispatch=name)
         with subkernel(name):
             return fn(*args, **kwargs)
     return run

@@ -73,11 +73,12 @@ def main(argv=None) -> int:
     def vet():
         # single static-analysis entry point (syzkaller_tpu/vet): lock
         # discipline, device hot-path purity, retrace hazards, RPC
-        # schema drift, and the stats lint (relocated from the inline
-        # regex here — now AST-based, same contract: raw self.stats[...]
-        # outside telemetry/ blocks the gate)
+        # schema drift, stats lint, and the buffer-lifetime passes
+        # (donation flow / host aliasing / epoch staleness).  --ratchet
+        # makes unbaselined P1s block too: the tree's P1 count can only
+        # go down, or each new one gets a justified baseline entry.
         r = subprocess.run(
-            [sys.executable, "-m", "syzkaller_tpu.vet"],
+            [sys.executable, "-m", "syzkaller_tpu.vet", "--ratchet"],
             cwd=root, env=env)
         if r.returncode != 0:
             raise SystemExit(f"vet failed ({r.returncode})")
@@ -170,6 +171,73 @@ print("console ok: %d managers, hub corpus %s"
                            cwd=root, env=env)
         if r.returncode != 0:
             raise SystemExit("console smoke failed")
+
+    # syz-san armed end-to-end: a tick storm through the full stack
+    # (DeviceSignal fused ticks + DecisionStream prefetch + a mid-storm
+    # injected failover on a ResilientEngine) must finish with ZERO
+    # sanitizer findings — the runtime plane agrees the production
+    # idioms are clean, not just the static plane.
+    _SAN_SMOKE = r"""
+import os
+os.environ["SYZ_SAN"] = "1"
+import numpy as np
+from syzkaller_tpu import san
+from syzkaller_tpu.cover.engine import CoverageEngine
+from syzkaller_tpu.fuzzer.device_ct import DecisionStream
+from syzkaller_tpu.fuzzer.device_signal import DeviceSignal
+from syzkaller_tpu.resilience import ResilientEngine
+
+def mk():
+    return CoverageEngine(npcs=1 << 10, ncalls=8, corpus_cap=64,
+                          batch=4, max_pcs_per_exec=16)
+
+sig = DeviceSignal(ncalls=8, npcs=1 << 13, flush_batch=4, max_pcs=16)
+ds = DecisionStream(sig.engine, per_row=8, hot_slots=64, corpus_rows=32,
+                    entropy_words=1024, autostart=False)
+rng = np.random.default_rng(7)
+for i in range(8):
+    win = rng.integers(1, 1 << 20, (4, 16)).astype(np.uint32)
+    counts = rng.integers(1, 16, (4,)).astype(np.int32)
+    cids = rng.integers(0, 8, (4,)).astype(np.int32)
+    ep = ds.epoch()
+    ticket, _res = sig.submit_tick(
+        win, counts, cids,
+        decision_sink=lambda d, epoch=None: ds.feed(-1, d, epoch=epoch),
+        decision_epoch=ep)
+    sig.resolve(ticket)
+    ds.refill_once()
+    ds.choose(prev_call_id=-1)
+    ds.take_entropy(64)
+    if i % 3 == 2:
+        ds.invalidate()
+
+# mid-storm failover, armed: the supervisor re-attaches the checker on
+# the fallback and the storm continues finding nothing
+eng = ResilientEngine(mk(), mk, probe_interval=0.0)
+stream = DecisionStream(eng, per_row=8, hot_slots=64, corpus_rows=32,
+                        entropy_words=1024, autostart=False)
+eng._on_swap = lambda d: stream.rebind()
+stream.refill_once()
+eng.injector.arm(1)
+for _ in range(4):
+    stream.refill_once()
+    stream.choose(prev_call_id=-1)
+assert eng.degraded and eng.stat_failovers == 1, \
+    (eng.degraded, eng.stat_failovers)
+eng.probe()
+assert not eng.degraded
+stream.refill_once()
+stream.stop(); ds.stop()
+s = san.summary()
+assert s["armed"] and s["total"] == 0, s
+print("san smoke ok: armed storm + failover, 0 findings")
+"""
+
+    def san_smoke():
+        r = subprocess.run([sys.executable, "-c", _SAN_SMOKE],
+                           cwd=root, env=env)
+        if r.returncode != 0:
+            raise SystemExit("san smoke failed")
 
     def chaos_smoke():
         # one SIGKILL/restore cycle against a real manager subprocess
@@ -324,6 +392,12 @@ print("console ok: %d managers, hub corpus %s"
         assert top and all(
             set(d) == {"name", "calls", "seconds_sum", "recompiles"}
             for d in top), "malformed dispatch_top table"
+        # syz-san acceptance: the smoke must measure the armed-vs-
+        # unarmed fuzz-tick cost so overhead drift is visible per run
+        # (tiny CPU shapes are noisy, so only sanity-bound it)
+        sanpct = out["extras"]["san_overhead_pct"]
+        assert isinstance(sanpct, (int, float)) and sanpct < 500, \
+            f"san overhead {sanpct}% out of envelope"
 
     total = 0.0
     total += step("description tables", gen_tables)
@@ -332,6 +406,7 @@ print("console ok: %d managers, hub corpus %s"
     total += step("engine + multichip smoke", engine_smoke)
     total += step("telemetry smoke", telemetry_smoke)
     total += step("console smoke (fleet observatory)", console_smoke)
+    total += step("san smoke (runtime sanitizer, armed)", san_smoke)
     total += step("chaos smoke (kill/restore cycle)", chaos_smoke)
     total += step("mesh smoke (two-process pod seam)", mesh_smoke)
     total += step("bench smoke", bench_smoke)

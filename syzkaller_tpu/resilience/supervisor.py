@@ -29,6 +29,7 @@ import inspect
 import threading
 import time
 
+from syzkaller_tpu import san as _san
 from syzkaller_tpu.utils import log
 from syzkaller_tpu.utils.gate import SharedExclusiveGate
 
@@ -232,6 +233,9 @@ class ResilientEngine:
                 if state is not None:
                     fb.import_state(state)
                 fb.adopt_frontiers(self._primary.frontier_views())
+                # syz-san survives the swap: the fallback may predate
+                # arming, so re-attach here (idempotent no-op otherwise)
+                _san.attach(fb)
                 self._fallback = fb
                 self._eng = fb
                 self.stat_failovers += 1
@@ -271,6 +275,7 @@ class ResilientEngine:
                 state = self._eng.export_state()
                 self._primary.import_state(state)
                 self._primary.adopt_frontiers(self._eng.frontier_views())
+                _san.attach(self._primary)   # see _failover
                 self._eng = self._primary
                 dur = self.degraded_seconds
                 self._degraded_since = None

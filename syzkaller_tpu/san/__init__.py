@@ -1,0 +1,75 @@
+"""syz-san: the runtime half of the device-buffer lifetime sanitizer.
+
+The static plane (syz-vet's donation/aliasing/epoch passes) proves the
+SHAPES are right; this plane watches the live objects, so each plane
+cross-checks the other's false-negative space — exactly the
+KASAN-next-to-lockdep layering the reference fuzzer assumes on the
+kernel side.  Opt-in via `SYZ_SAN=1` (or `attach(force=True)` from a
+harness); unarmed, every hook is a single falsy branch and ZERO extra
+device dispatches.
+
+Components:
+
+  * shadow checker (`attach`) — wraps the engine's jitted dispatch
+    closures (riding the DispatchProfiler wrapper contract, so the two
+    compose in either order), verifies no operand is a deleted/donated
+    buffer, and POISONS engine attributes still referencing a donated
+    array at the next dispatch (guard proxy raising with the donation
+    stack on any access);
+  * generation tracker (`stamp`/`verify`) — checksums host buffers
+    handed to async dispatches and re-verifies at resolve time:
+    mutation-in-flight is a hard error carrying both stacks (the
+    runtime twin of the aliasing pass / PR-15 bug);
+  * lockset audit (`audit_lock`) — runtime confirmation of the static
+    lock-discipline pass over the gate/mutex seams: dispatching device
+    work while holding a non-dispatch lock raises.
+
+Findings are hard errors AND are recorded in the process-global
+`report` (tools/ci.py publishes its summary as a build artifact).
+"""
+
+from __future__ import annotations
+
+import os
+
+from syzkaller_tpu.san.report import Report, report  # noqa: F401
+from syzkaller_tpu.san.errors import (                # noqa: F401
+    LockAuditError, MutationInFlightError, SanError, UseAfterDonateError)
+from syzkaller_tpu.san.generation import GenerationTracker, stamp, verify
+from syzkaller_tpu.san.lockset import LocksetAudit, audit_lock
+from syzkaller_tpu.san.shadow import PoisonProxy, ShadowChecker, \
+    check_operands
+
+__all__ = [
+    "armed", "attach", "report", "Report", "stamp", "verify",
+    "audit_lock", "check_operands", "summary", "SanError",
+    "UseAfterDonateError", "MutationInFlightError", "LockAuditError",
+    "GenerationTracker", "LocksetAudit", "ShadowChecker", "PoisonProxy",
+]
+
+
+def armed() -> bool:
+    """True when the sanitizer is opted in (`SYZ_SAN=1`)."""
+    return os.environ.get("SYZ_SAN", "0") not in ("", "0")
+
+
+_checker: "ShadowChecker | None" = None
+
+
+def attach(engine, force: bool = False) -> list:
+    """Arm the shadow checker on one engine (idempotent; re-run after a
+    failover rebuild).  No-op returning [] unless armed or `force` —
+    the unarmed cost is this one branch."""
+    if not (force or armed()):
+        return []
+    global _checker
+    if _checker is None:
+        _checker = ShadowChecker(report)
+    return _checker.attach(engine)
+
+
+def summary() -> dict:
+    """The sanitizer summary tools/ci.py publishes as an artifact."""
+    out = report.summary()
+    out["armed"] = armed()
+    return out

@@ -1,0 +1,28 @@
+"""syz-san hard-error types.
+
+Deliberately NOT subclasses of RuntimeError: the resilience
+supervisor's FAULT_TYPES treats RuntimeError as a device-flap worth
+failing over for, and a sanitizer finding must never be absorbed by a
+failover retry — it has to surface to the harness that armed the
+sanitizer."""
+
+from __future__ import annotations
+
+
+class SanError(Exception):
+    """Base class for sanitizer findings raised as errors."""
+
+
+class UseAfterDonateError(SanError):
+    """A Python reference passed in a donated slot was touched after
+    the dispatch (its device buffer belongs to XLA)."""
+
+
+class MutationInFlightError(SanError):
+    """A host buffer handed to an async dispatch was mutated before
+    the dispatch resolved (the PR-15 aliasing corruption class)."""
+
+
+class LockAuditError(SanError):
+    """Device work dispatched while holding a lock the lock-discipline
+    contract says must never be held across dispatches."""

@@ -1,0 +1,94 @@
+"""Generation counters for host buffers handed to async dispatches.
+
+The ticket pattern (`submit_slabs` → resolve, `DecisionStream._cycle`
+→ `_publish`) keeps a host numpy buffer referenced across an async
+device dispatch.  On CPU backends the dispatch may read the buffer
+ZERO-COPY, so a host mutation between submit and resolve silently
+feeds the dispatch future values (PR 15).  `stamp()` checksums the
+buffer at submit; `verify()` re-checksums at resolve and raises
+`MutationInFlightError` carrying BOTH stacks — where the buffer was
+handed off and where the corruption was detected.
+
+Large buffers are sampled (head + tail + shape/dtype) so the armed
+cost stays O(KB) per dispatch, not O(buffer)."""
+
+from __future__ import annotations
+
+import traceback
+import zlib
+
+import numpy as np
+
+from syzkaller_tpu.san.errors import MutationInFlightError
+from syzkaller_tpu.san.report import report
+
+# full-checksum threshold: beyond this, sample head+tail windows
+_FULL_BYTES = 1 << 16
+_WINDOW = 4096
+
+
+class GenToken:
+    __slots__ = ("label", "digest", "buf", "stack")
+
+    def __init__(self, label: str, digest: int, buf, stack: str):
+        self.label = label
+        self.digest = digest
+        self.buf = buf
+        self.stack = stack
+
+
+def _digest(buf: np.ndarray) -> int:
+    flat = buf.reshape(-1)
+    meta = f"{buf.shape}|{buf.dtype}".encode()
+    if buf.nbytes <= _FULL_BYTES:
+        body = np.ascontiguousarray(flat).tobytes()
+    else:
+        n = max(1, _WINDOW // max(1, buf.itemsize))
+        body = np.ascontiguousarray(flat[:n]).tobytes() \
+            + np.ascontiguousarray(flat[-n:]).tobytes()
+    return zlib.crc32(body, zlib.crc32(meta))
+
+
+class GenerationTracker:
+    """stamp/verify pairs over one report sink (the module-level
+    `stamp`/`verify` ride the global report)."""
+
+    def __init__(self, sink=None):
+        self._report = sink if sink is not None else report
+
+    def stamp(self, buf, label: str = "buffer") -> "GenToken | None":
+        """Checksum a host buffer at dispatch-submit time.  None for
+        non-ndarray handoffs (device arrays are XLA's problem)."""
+        if not isinstance(buf, np.ndarray) or buf.size == 0:
+            return None
+        stack = "".join(traceback.format_stack(limit=12))
+        return GenToken(label, _digest(buf), buf, stack)
+
+    def verify(self, token: "GenToken | None") -> None:
+        """Re-checksum at resolve time; a moved digest means the host
+        mutated the buffer while the dispatch could still read it."""
+        if token is None:
+            return
+        now = _digest(token.buf)
+        if now == token.digest:
+            return
+        here = "".join(traceback.format_stack(limit=12))
+        msg = (f"host buffer `{token.label}` mutated while its dispatch "
+               f"was in flight (generation {token.digest:#010x} -> "
+               f"{now:#010x}): the dispatch may have read future values")
+        self._report.record("mutation-in-flight", msg, stacks={
+            "submit": token.stack, "resolve": here})
+        raise MutationInFlightError(
+            f"{msg}\n--- handed off at ---\n{token.stack}"
+            f"--- detected at ---\n{here}")
+
+
+_tracker = GenerationTracker()
+
+
+def stamp(buf, label: str = "buffer") -> "GenToken | None":
+    return _tracker.stamp(buf, label)
+
+
+def verify(token: "GenToken | None") -> None:
+    _tracker.verify(token)

@@ -1,0 +1,142 @@
+"""Runtime lockset audit over the gate/mutex seams.
+
+syz-vet's lock-discipline pass proves statically that no device work
+runs under a lock; this is its runtime twin — `audit_lock` swaps a
+lock attribute for a recording wrapper, the shadow checker asks
+`on_dispatch()` at every wrapped dispatch, and holding a non-dispatch
+lock there raises `LockAuditError`.  The engine's `_state_mu` is the
+DOCUMENTED exception (donated-buffer serialization requires the hold),
+so it registers with `allow_dispatch=True`.
+
+Lock-order edges are recorded per acquisition pair; an inversion
+(A→B observed after B→A) is logged to the report as `lock-order`
+(recorded, not raised: an inversion is a deadlock RISK, and killing
+the storm that exposed it would hide the evidence)."""
+
+from __future__ import annotations
+
+import threading
+import traceback
+
+from syzkaller_tpu.san.errors import LockAuditError
+from syzkaller_tpu.san.report import report as _report
+
+_tls = threading.local()
+
+
+def _held() -> list:
+    h = getattr(_tls, "held", None)
+    if h is None:
+        h = _tls.held = []
+    return h
+
+
+class AuditedLock:
+    """Context-manager/acquire-release wrapper recording per-thread
+    holds.  Transparent for Lock and RLock (re-entrant holds stack)."""
+
+    def __init__(self, inner, name: str, audit: "LocksetAudit",
+                 allow_dispatch: bool = False):
+        self._inner = inner
+        self.name = name
+        self.allow_dispatch = allow_dispatch
+        self._audit = audit
+
+    def acquire(self, *args, **kwargs):
+        ok = self._inner.acquire(*args, **kwargs)
+        if ok:
+            self._audit._on_acquire(self)
+            _held().append(self)
+        return ok
+
+    def release(self):
+        h = _held()
+        if self in h:
+            # remove the innermost hold (RLock re-entry unwinds LIFO)
+            for i in range(len(h) - 1, -1, -1):
+                if h[i] is self:
+                    del h[i]
+                    break
+        return self._inner.release()
+
+    def __enter__(self):
+        self.acquire()
+        return self
+
+    def __exit__(self, *exc):
+        self.release()
+        return False
+
+    def locked(self):
+        return self._inner.locked()
+
+
+class LocksetAudit:
+    """Order-edge bookkeeping + the dispatch-time lockset check."""
+
+    def __init__(self, sink=None):
+        self._report = sink if sink is not None else _report
+        self._mu = threading.Lock()
+        self._edges: dict[tuple, str] = {}
+        self._inversions: set[tuple] = set()
+
+    def wrap(self, owner, attr: str, name: str,
+             allow_dispatch: bool = False) -> AuditedLock:
+        """Swap `owner.<attr>` for an audited wrapper (idempotent)."""
+        cur = getattr(owner, attr)
+        if isinstance(cur, AuditedLock):
+            return cur
+        lk = AuditedLock(cur, name, self, allow_dispatch=allow_dispatch)
+        setattr(owner, attr, lk)
+        return lk
+
+    def _on_acquire(self, lock: AuditedLock) -> None:
+        held = _held()
+        if not held:
+            return
+        with self._mu:
+            for h in held:
+                if h is lock:
+                    continue            # RLock re-entry, not an edge
+                edge = (h.name, lock.name)
+                rev = (lock.name, h.name)
+                if edge not in self._edges:
+                    self._edges[edge] = "".join(
+                        traceback.format_stack(limit=8))
+                if rev in self._edges and edge not in self._inversions \
+                        and rev not in self._inversions:
+                    self._inversions.add(edge)
+                    self._report.record(
+                        "lock-order",
+                        f"lock-order inversion: {h.name} -> {lock.name} "
+                        f"observed after {lock.name} -> {h.name} "
+                        "(deadlock risk)",
+                        stacks={"this": self._edges[edge],
+                                "reverse": self._edges[rev]})
+
+    def on_dispatch(self, dispatch: str) -> None:
+        """Called by the shadow checker inside every wrapped dispatch:
+        holding a non-dispatch audited lock here is the race the static
+        pass calls device-sync-under-lock."""
+        foreign = [l.name for l in _held() if not l.allow_dispatch]
+        if not foreign:
+            return
+        here = "".join(traceback.format_stack(limit=12))
+        msg = (f"device dispatch `{dispatch}` issued while holding "
+               f"{', '.join(foreign)} — locks must never be held "
+               "across device work")
+        self._report.record("dispatch-under-lock", msg,
+                            stacks={"dispatch": here})
+        raise LockAuditError(msg)
+
+    def held_names(self) -> list[str]:
+        return [l.name for l in _held()]
+
+
+# the process-global audit the shadow checker consults
+audit = LocksetAudit()
+
+
+def audit_lock(owner, attr: str, name: str,
+               allow_dispatch: bool = False) -> AuditedLock:
+    return audit.wrap(owner, attr, name, allow_dispatch=allow_dispatch)

@@ -1,0 +1,69 @@
+"""Process-global sanitizer finding log.
+
+Every hard error the sanitizer raises is also recorded here, so a
+harness that catches (or a chaos storm that absorbs) the exception
+still leaves an auditable trail, and tools/ci.py can publish the
+summary as a build artifact next to the vet JSON report."""
+
+from __future__ import annotations
+
+import threading
+import time
+
+
+class Report:
+    """Thread-safe append-only finding log + counters."""
+
+    MAX_FINDINGS = 256          # bounded: a storm must not OOM the host
+
+    def __init__(self):
+        self._mu = threading.Lock()
+        self._findings: list[dict] = []
+        self._counts: dict[str, int] = {}
+        self._dropped = 0
+
+    def record(self, kind: str, message: str, *,
+               stacks: "dict[str, str] | None" = None) -> dict:
+        entry = {"kind": kind, "message": message,
+                 "time": time.time(), "stacks": stacks or {}}
+        with self._mu:
+            self._counts[kind] = self._counts.get(kind, 0) + 1
+            if len(self._findings) < self.MAX_FINDINGS:
+                self._findings.append(entry)
+            else:
+                self._dropped += 1
+        return entry
+
+    def findings(self) -> list[dict]:
+        with self._mu:
+            return list(self._findings)
+
+    def counts(self) -> dict:
+        with self._mu:
+            return dict(self._counts)
+
+    @property
+    def total(self) -> int:
+        with self._mu:
+            return sum(self._counts.values())
+
+    def clear(self) -> None:
+        with self._mu:
+            self._findings.clear()
+            self._counts.clear()
+            self._dropped = 0
+
+    def summary(self) -> dict:
+        with self._mu:
+            return {
+                "total": sum(self._counts.values()),
+                "counts": dict(self._counts),
+                "dropped": self._dropped,
+                "findings": [
+                    {k: v for k, v in f.items() if k != "stacks"}
+                    for f in self._findings],
+            }
+
+
+# the process-global log every component records into
+report = Report()

@@ -1,0 +1,277 @@
+"""Shadow checker: the live-object half of the donation-flow pass.
+
+`ShadowChecker.attach` wraps the engine's jitted dispatch closures
+(the same `DISPATCH_ATTRS` surface `DispatchProfiler.attach` wraps,
+honouring the same idempotence contract so the two compose in either
+order).  Around each dispatch it:
+
+  * asks the lockset audit whether a non-dispatch lock is held
+    (`dispatch-under-lock`, the runtime device-sync-under-lock);
+  * refuses operands that are poison proxies or already-deleted jax
+    arrays (use-after-donate caught AT the reuse site, with the
+    donation stack);
+  * after a donating dispatch, remembers which engine attributes still
+    reference the donated operands; at the NEXT dispatch — by which
+    point the donated-carry idiom must have rebound them — any
+    attribute still holding the stale object is swapped for a
+    `PoisonProxy`, so the first later touch raises with both stacks.
+
+Donation specs are derived by parsing `cover/engine.py` with the vet
+donation pass's own index helpers — the static pass is the single
+source of truth for which `_*_fn` slots donate which argnums, so the
+two planes can never drift apart.
+"""
+
+from __future__ import annotations
+
+import ast
+import threading
+import traceback
+import weakref
+
+from syzkaller_tpu.observe.profile import DISPATCH_ATTRS
+from syzkaller_tpu.san.errors import UseAfterDonateError
+from syzkaller_tpu.san.lockset import audit, audit_lock
+from syzkaller_tpu.san.report import report as _default_report
+
+# pending-poison entries surviving to the next dispatch, per checker
+_MAX_PENDING = 64
+_STACK_LIMIT = 12
+
+
+class PoisonProxy:
+    """Guard standing in for a donated buffer that was never rebound.
+    Any data access — attribute, item, iteration, array conversion —
+    raises `UseAfterDonateError` carrying the donation stack.  `repr`
+    stays safe so debuggers and log formatting survive."""
+
+    def __init__(self, label: str, stack: str):
+        object.__setattr__(self, "_poison_label", label)
+        object.__setattr__(self, "_poison_stack", stack)
+
+    def _poison_boom(self):
+        raise UseAfterDonateError(
+            f"use-after-donate: `{self._poison_label}` was passed in a "
+            "donated slot and never rebound from the dispatch result — "
+            "its device buffer belongs to XLA\n--- donated at ---\n"
+            f"{self._poison_stack}")
+
+    def __repr__(self):
+        return f"<PoisonProxy donated:{self._poison_label}>"
+
+    def __getattr__(self, name):
+        self._poison_boom()
+
+    def __setattr__(self, name, value):
+        self._poison_boom()
+
+    def __getitem__(self, key):
+        self._poison_boom()
+
+    def __setitem__(self, key, value):
+        self._poison_boom()
+
+    def __array__(self, *args, **kwargs):
+        self._poison_boom()
+
+    def __len__(self):
+        self._poison_boom()
+
+    def __iter__(self):
+        self._poison_boom()
+
+    def __bool__(self):
+        self._poison_boom()
+
+    def __float__(self):
+        self._poison_boom()
+
+    def __int__(self):
+        self._poison_boom()
+
+    def __index__(self):
+        self._poison_boom()
+
+
+def check_operands(args, dispatch: str = "kernel") -> None:
+    """Raise if any operand is a poisoned (donated, never-rebound)
+    reference.  Kernel seams (`kernels/registry`) call this so a
+    poisoned buffer can't slip into a fused dispatch unnoticed."""
+    for a in args:
+        if isinstance(a, PoisonProxy):
+            raise UseAfterDonateError(
+                f"poisoned buffer `{a._poison_label}` passed to "
+                f"`{dispatch}`\n--- donated at ---\n{a._poison_stack}")
+
+
+_spec_mu = threading.Lock()
+_specs: "dict[str, tuple[int, ...]] | None" = None
+
+
+def _donation_specs() -> "dict[str, tuple[int, ...]]":
+    """attr name (`_update_fn`) -> donated argnums, parsed once from
+    cover/engine.py via the vet donation index helpers."""
+    global _specs
+    with _spec_mu:
+        if _specs is not None:
+            return _specs
+        specs: dict[str, tuple[int, ...]] = {}
+        try:
+            import inspect
+
+            from syzkaller_tpu.cover import engine as engine_mod
+            from syzkaller_tpu.vet import donation
+
+            tree = ast.parse(inspect.getsource(engine_mod))
+            for fdef, spec in donation._file_defs(tree).items():
+                for attr in donation._attr_bindings(tree, fdef.name):
+                    prev = specs.get(attr, ())
+                    specs[attr] = tuple(sorted(set(prev) | set(spec)))
+        except (OSError, SyntaxError, TypeError):
+            pass                    # frozen/stripped install: no specs
+        _specs = specs
+        return _specs
+
+
+class ShadowChecker:
+    """Per-process shadow checker; attach to each engine (and re-attach
+    after a failover rebuild — wrapping is idempotent)."""
+
+    def __init__(self, sink=None, specs=None):
+        self._report = sink if sink is not None else _default_report
+        self._mu = threading.Lock()
+        # (engine weakref | None, attr | None, donated obj, label, stack)
+        self._pending: list = []
+        self._specs_override = specs
+
+    # -- wiring ------------------------------------------------------------
+
+    def attach(self, engine) -> "list[str]":
+        specs = self._specs_override if self._specs_override is not None \
+            else _donation_specs()
+        if getattr(engine, "__dict__", None) is not None and \
+                "_state_mu" in engine.__dict__:
+            # the documented held-across-dispatch exception: _state_mu
+            # SERIALIZES donated-buffer rebinds, so it must be held
+            audit_lock(engine, "_state_mu", "engine._state_mu",
+                       allow_dispatch=True)
+        wrapped = []
+        for attr in DISPATCH_ATTRS:
+            fn = getattr(engine, attr, None)
+            if fn is None or not callable(fn):
+                continue
+            name = attr.strip("_")
+            if name.endswith("_fn"):
+                name = name[:-3]
+            if _already_san(fn):
+                wrapped.append(name)
+                continue
+            setattr(engine, attr,
+                    self._wrap(engine, attr, name, fn, specs.get(attr, ())))
+            wrapped.append(name)
+        return wrapped
+
+    def _wrap(self, engine, attr, name, fn, spec):
+        def sanitized(*args, **kwargs):
+            self._pre_dispatch(name, args)
+            out = fn(*args, **kwargs)
+            if spec:
+                self._post_dispatch(engine, name, spec, args)
+            return out
+
+        sanitized._syz_san = name
+        # propagate the profiler marker so ITS attach stays idempotent
+        # when it ran first; when san runs first the marker is absent
+        # and the profiler is still free to wrap on top
+        inner = getattr(fn, "_syz_dispatch", None)
+        if inner is not None:
+            sanitized._syz_dispatch = inner
+        sanitized.__wrapped__ = fn
+        return sanitized
+
+    # -- checks ------------------------------------------------------------
+
+    def _pre_dispatch(self, name: str, args) -> None:
+        audit.on_dispatch(name)
+        check_operands(args, dispatch=name)
+        for a in args:
+            deleted = getattr(a, "is_deleted", None)
+            if callable(deleted):
+                try:
+                    gone = bool(deleted())
+                except Exception:
+                    gone = False
+                if gone:
+                    msg = (f"deleted (donated) jax array passed to "
+                           f"`{name}` — its buffer was handed to XLA by "
+                           "an earlier dispatch")
+                    self._report.record("use-after-donate", msg)
+                    raise UseAfterDonateError(msg)
+        self._sweep(args, name)
+
+    def _sweep(self, args, name: str) -> None:
+        """Settle last dispatch's donations: by now the donated-carry
+        idiom must have rebound every donated reference."""
+        with self._mu:
+            if not self._pending:
+                return
+            pend, self._pending = self._pending, []
+        for eref, attr, obj, label, stack in pend:
+            if any(a is obj for a in args):
+                here = "".join(traceback.format_stack(limit=_STACK_LIMIT))
+                msg = (f"use-after-donate: `{label}` was donated and is "
+                       f"being passed to `{name}` again without a rebind")
+                self._report.record("use-after-donate", msg, stacks={
+                    "donated": stack, "reused": here})
+                raise UseAfterDonateError(
+                    f"{msg}\n--- donated at ---\n{stack}"
+                    f"--- reused at ---\n{here}")
+            eng = eref() if eref is not None else None
+            if eng is None or attr is None:
+                continue
+            if eng.__dict__.get(attr) is obj:
+                self._report.record(
+                    "donated-ref-unrebound",
+                    f"engine.{attr} still references the buffer donated "
+                    f"by `{label}`; poisoning it", stacks={"donated": stack})
+                setattr(eng, attr, PoisonProxy(f"engine.{attr}", stack))
+
+    def _post_dispatch(self, engine, name, spec, args) -> None:
+        stack = "".join(traceback.format_stack(limit=_STACK_LIMIT))
+        try:
+            eref = weakref.ref(engine)
+        except TypeError:
+            eref = None
+        entries = []
+        attrs = getattr(engine, "__dict__", {})
+        for i in spec:
+            if i >= len(args):
+                continue
+            obj = args[i]
+            if obj is None or isinstance(obj, (bool, int, float, str,
+                                               bytes, PoisonProxy)):
+                continue
+            label = f"{name} arg{i}"
+            bound = [a for a, v in list(attrs.items()) if v is obj]
+            if bound:
+                entries.extend(
+                    (eref, a, obj, f"{label} (engine.{a})", stack)
+                    for a in bound)
+            else:
+                entries.append((None, None, obj, label, stack))
+        if entries:
+            with self._mu:
+                self._pending.extend(entries)
+                del self._pending[:-_MAX_PENDING]
+
+
+def _already_san(fn) -> bool:
+    """True if `fn` (or anything below it in the __wrapped__ chain —
+    the profiler may have wrapped on top) is already sanitized."""
+    seen = 0
+    while fn is not None and seen < 8:
+        if getattr(fn, "_syz_san", None) is not None:
+            return True
+        fn = getattr(fn, "__wrapped__", None)
+        seen += 1
+    return False

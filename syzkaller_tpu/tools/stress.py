@@ -138,23 +138,39 @@ class Stress:
 
     def ingest(self, p: M.Prog, res: ipc.ExecResult) -> None:
         data = P.serialize(p)
+        batches = []
         with self._lock:
             self.stats.exec_calls += len(res.calls)
             for c in res.calls:
                 if c.index < len(p.calls) and len(c.cover):
                     call_id = p.calls[c.index].meta.id
                     self._pending.append((data, c.index, call_id, c.cover))
-            while len(self._pending) >= self.opts.flush_batch:
-                self.flush()
+            B = self.opts.flush_batch
+            while len(self._pending) >= B:
+                batches.append(self._pending[:B])
+                self._pending = self._pending[B:]
+        # device steps run OUTSIDE _lock (syz-vet device-sync-under-
+        # lock): the engine serializes its own state mutation, so the
+        # host lock only needs to guard pending/stats
+        for pend in batches:
+            self._flush(pend)
 
     def flush(self) -> None:
-        """One fixed-shape device step for up to flush_batch pending exec
-        calls (called with lock). Short batches are padded — a varying
-        batch shape would trigger an XLA recompile per flush."""
+        """Drain everything still pending (shutdown path)."""
+        with self._lock:
+            pend, self._pending = self._pending, []
         B = self.opts.flush_batch
-        pend, self._pending = self._pending[:B], self._pending[B:]
+        while pend:
+            head, pend = pend[:B], pend[B:]
+            self._flush(head)
+
+    def _flush(self, pend) -> None:
+        """One fixed-shape device step for up to flush_batch exec calls
+        (no host lock held). Short batches are padded — a varying batch
+        shape would trigger an XLA recompile per flush."""
         if not pend:
             return
+        B = self.opts.flush_batch
         covers = [cov for (_, _, _, cov) in pend]
         covers += [np.zeros(0, np.uint32)] * (B - len(covers))
         call_ids = np.zeros((B,), np.int32)
@@ -167,19 +183,27 @@ class Stress:
         if self.engine.admit_rows(result, call_ids, new_rows) is None:
             # device corpus full: drop on the host side too so the two
             # stay consistent (a manager-driven minimize frees space)
-            if not getattr(self, "_warned_full", False):
-                self._warned_full = True
+            with self._lock:
+                warned, self._warned_full = \
+                    getattr(self, "_warned_full", False), True
+            if not warned:
                 log.logf(0, "corpus capacity %d reached; new inputs dropped",
                          self.engine.cap)
             return
+        progs = []
         for i in new_rows:
             data, call_index, _cid, _cov = pend[i]
-            self.stats.new_inputs += 1
-            self.stats.corpus.append((data, call_index))
             try:
-                self.corpus_progs.append(P.deserialize(data, self.table))
+                progs.append((data, call_index,
+                              P.deserialize(data, self.table)))
             except P.DeserializeError:
-                pass
+                progs.append((data, call_index, None))
+        with self._lock:
+            for data, call_index, prog in progs:
+                self.stats.new_inputs += 1
+                self.stats.corpus.append((data, call_index))
+                if prog is not None:
+                    self.corpus_progs.append(prog)
 
     def run(self) -> StressStats:
         threads = [threading.Thread(target=self.proc_loop, args=(pid,),
@@ -196,19 +220,20 @@ class Stress:
                 now = time.time()
                 if now - last_log > self.opts.log_every:
                     last_log = now
+                    # device sync outside _lock (syz-vet)
+                    cover = int(self.engine.cover_counts().sum())
                     with self._lock:
                         rate = self.stats.execs / max(now - t0, 1e-9)
-                        log.logf(0, "execs %d (%.0f/sec) corpus %d cover %d",
-                                 self.stats.execs, rate,
-                                 len(self.stats.corpus),
-                                 int(self.engine.cover_counts().sum()))
+                        execs, ncorp = self.stats.execs, \
+                            len(self.stats.corpus)
+                    log.logf(0, "execs %d (%.0f/sec) corpus %d cover %d",
+                             execs, rate, ncorp, cover)
         except KeyboardInterrupt:
             self._stop = True
             for t in threads:
                 t.join(timeout=2.0)
-        with self._lock:
-            self.flush()
-            self.stats.cover_pcs = int(self.engine.cover_counts().sum())
+        self.flush()        # workers have exited; drains without _lock
+        self.stats.cover_pcs = int(self.engine.cover_counts().sum())
         return self.stats
 
 

@@ -3,6 +3,8 @@
 Runs every pass over the package (default) or the given files/dirs,
 applies the baseline, prints findings, and exits 1 on any unbaselined
 P0 — the presubmit gate's single static-analysis entry point.
+`--ratchet` additionally blocks on unbaselined P1s, so the tree's P1
+count can only go down (each new one needs a justified baseline entry).
 """
 
 from __future__ import annotations
@@ -19,7 +21,8 @@ def main(argv=None) -> int:
         prog="python -m syzkaller_tpu.vet",
         description="syz-vet static analyzer (lock discipline, device "
                     "hot-path purity, retrace hazards, RPC schema "
-                    "drift, stats lint)")
+                    "drift, stats lint, donation flow, host aliasing, "
+                    "epoch staleness)")
     ap.add_argument("paths", nargs="*",
                     help="files/dirs to analyze (default: the "
                          "syzkaller_tpu package + bench.py)")
@@ -29,11 +32,17 @@ def main(argv=None) -> int:
                     help="suppression file (default: <repo>/vet-"
                          "baseline.txt)")
     ap.add_argument("--write-baseline", default=None, metavar="PATH",
-                    help="append idents of current unbaselined P0s to "
+                    help="append idents of current unbaselined P0s "
+                         "(and, with --ratchet, unbaselined P1s) to "
                          "PATH (justifications still required by hand)")
+    ap.add_argument("--ratchet", action="store_true",
+                    help="also fail on unbaselined P1 findings (the "
+                         "P1-count ratchet: new P1s must be fixed or "
+                         "justified in the baseline)")
     ap.add_argument("--passes", default=None,
                     help="comma-separated subset: lock,purity,retrace,"
-                         "schema,stats")
+                         "schema,stats,hotpath,kernel-parity,donation,"
+                         "aliasing,epoch")
     ap.add_argument("-v", "--verbose", action="store_true",
                     help="also print P1 findings in text mode")
     args = ap.parse_args(argv)
@@ -51,15 +60,21 @@ def main(argv=None) -> int:
         return 1
 
     if args.write_baseline:
+        todo = list(rep.p0_unbaselined)
+        if args.ratchet:
+            todo += rep.p1_unbaselined
         with open(args.write_baseline, "a", encoding="utf-8") as f:
-            for fd in rep.p0_unbaselined:
+            for fd in todo:
                 f.write(f"{fd.ident}  # TODO: justify\n")
 
     if args.json:
         print(core.main_json(rep))
     else:
-        print(rep.render(verbose=args.verbose))
-    return 1 if (rep.p0_unbaselined or rep.parse_errors) else 0
+        print(rep.render(verbose=args.verbose or args.ratchet))
+    fail = bool(rep.p0_unbaselined or rep.parse_errors)
+    if args.ratchet:
+        fail = fail or bool(rep.p1_unbaselined)
+    return 1 if fail else 0
 
 
 if __name__ == "__main__":

@@ -1,0 +1,222 @@
+"""Host-aliasing pass: numpy buffers mutated while a dispatch may be
+outstanding.
+
+The PR-15 bug class: on CPU backends `jnp.asarray` can ALIAS a numpy
+buffer instead of copying it, and JAX dispatch is async — so an
+in-place write to the host buffer after the handoff races the device
+read, and the dispatch observes FUTURE values (silent corruption, the
+host/device analog of a kernel use-after-free).  The shipped fix makes
+device handoffs copy; this pass keeps that invariant:
+
+  * taint local numpy buffers (np.* constructors, `.copy()` chains)
+    when the BARE reference flows into a device handoff —
+    `jnp.asarray(x)`, `jax.device_put(x)`, `*.put_replicated(x)`, or a
+    jitted-closure operand (`*_fn(..., x, ...)`);
+  * handoffs that copy (`jnp.asarray(x.copy())`, `np.array(x)`
+    wrappers) do not taint — that is the fix idiom;
+  * flag any later in-place mutation of a tainted buffer (subscript /
+    augmented assignment, `.fill/.sort/.partition`, `np.copyto`)
+    before a synchronization point (P1 `mutate-after-handoff`);
+  * `np.asarray(...)` / `block_until_ready` host syncs clear all
+    taints — after a sync the outstanding dispatch has materialized
+    and the buffer is the host's again.  Loop bodies get a second pass
+    so a handoff late in iteration N is checked against mutations
+    early in iteration N+1 (the double-buffered-ring shape).
+"""
+
+from __future__ import annotations
+
+import ast
+
+from syzkaller_tpu.vet.core import P1, Finding, SourceFile, dotted, \
+    qualname_map
+from syzkaller_tpu.vet.donation import _expr_parts, _stmts, _targets
+
+PASS = "aliasing"
+
+# np.* callees whose result is a host ndarray worth tracking
+_NP_CTORS = {"zeros", "ones", "empty", "full", "arange", "asarray",
+             "array", "frombuffer", "fromiter", "concatenate", "stack",
+             "copy", "zeros_like", "ones_like", "empty_like", "full_like"}
+
+# device handoff callees: the bare-name operand aliases host memory
+_HANDOFF_FNS = {"jnp.asarray", "jax.device_put"}
+_HANDOFF_SUFFIX = ("put_replicated", "put_row_sharded", "device_put")
+
+# in-place mutator methods on ndarrays
+_MUTATORS = {"fill", "sort", "partition", "put", "setfield"}
+
+# host synchronization callees: the outstanding dispatch has resolved
+_SYNC_FNS = {"np.asarray", "np.array"}
+_SYNC_SUFFIX = ("block_until_ready",)
+
+
+def _np_root(call: ast.Call) -> bool:
+    d = dotted(call.func)
+    return d.startswith("np.") and d.split(".")[-1] in _NP_CTORS \
+        or d.startswith("numpy.") and d.split(".")[-1] in _NP_CTORS
+
+
+def _is_handoff(call: ast.Call) -> bool:
+    d = dotted(call.func)
+    if d in _HANDOFF_FNS or d.endswith(_HANDOFF_SUFFIX):
+        return True
+    # jitted dispatch closures: self._update_fn(...), eng._step_fn(...)
+    return isinstance(call.func, ast.Attribute) and \
+        call.func.attr.endswith("_fn")
+
+
+def _is_sync(call: ast.Call) -> bool:
+    d = dotted(call.func)
+    return d in _SYNC_FNS or d.endswith(_SYNC_SUFFIX)
+
+
+def check(files: list[SourceFile]) -> list[Finding]:
+    out: list[Finding] = []
+    for sf in files:
+        qmap = qualname_map(sf.tree)
+        for node, qual in qmap.items():
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                out.extend(_scan_fn(sf, node, qual))
+    return out
+
+
+def _scan_fn(sf, fn, qual) -> list[Finding]:
+    body = [st for st, _ in _stmts(fn.body)
+            if not isinstance(st, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                   ast.ClassDef))]
+    findings: list[Finding] = []
+    numpy_locals: set[str] = set()
+    tainted: dict[str, int] = {}        # name -> handoff line
+
+    def exprs(st):
+        for part in _expr_parts(st):
+            yield from ast.walk(part)
+
+    def visit(st):
+        # 1. mutations of tainted buffers (checked against the taint
+        #    state BEFORE this statement's own handoffs land)
+        for nm, ln in _mutations(st):
+            hl = tainted.get(nm)
+            if hl is not None:
+                findings.append(Finding(
+                    pass_name=PASS, rule="mutate-after-handoff",
+                    severity=P1, path=sf.path, line=ln, scope=qual,
+                    message=(f"host buffer `{nm}` handed to a device "
+                             f"dispatch at line {hl} is mutated in "
+                             "place while the dispatch may still be "
+                             "outstanding — on CPU jnp.asarray can "
+                             "alias it, so the dispatch reads FUTURE "
+                             "values (the PR-15 silent-corruption bug)"),
+                    hint="copy at the handoff (jnp.asarray(x.copy()) / "
+                         "np.array(x)) or sync the dispatch before "
+                         "touching the buffer",
+                    detail=nm))
+                tainted.pop(nm, None)
+        # 2. syncs clear every taint; handoffs add
+        for node in exprs(st):
+            if not isinstance(node, ast.Call):
+                continue
+            if _is_sync(node):
+                tainted.clear()
+            elif _is_handoff(node):
+                for a in node.args:
+                    nm, ln = _aliased_operand(a)
+                    if nm and nm in numpy_locals:
+                        tainted[nm] = ln
+        # 3. track numpy locals + rebinding (a fresh object sheds taint)
+        tgts = _targets(st)
+        if isinstance(st, ast.Assign) and isinstance(st.value, ast.Call) \
+                and (_np_root(st.value) or _copy_chain(st.value)):
+            numpy_locals.update(t for t in tgts if "." not in t)
+        for nm in tgts:
+            tainted.pop(nm, None)
+
+    for st in body:
+        visit(st)
+    # loop-carried pass: handoff in iteration N vs mutation in N+1
+    for loop in ast.walk(fn):
+        if not isinstance(loop, (ast.For, ast.While)):
+            continue
+        lbody = [st for st, _ in _stmts(loop.body)
+                 if not isinstance(st, (ast.FunctionDef,
+                                        ast.AsyncFunctionDef, ast.ClassDef))]
+        synced = any(
+            isinstance(n, ast.Call) and _is_sync(n)
+            for st in lbody for p in _expr_parts(st) for n in ast.walk(p))
+        if synced:
+            continue
+        rebinds = _loop_rebinds(loop, lbody)
+        tainted.clear()
+        for st in lbody:
+            for p in _expr_parts(st):
+                for node in ast.walk(p):
+                    if isinstance(node, ast.Call) and _is_handoff(node):
+                        for a in node.args:
+                            nm, ln = _aliased_operand(a)
+                            if nm and nm in numpy_locals \
+                                    and nm not in rebinds:
+                                tainted[nm] = ln
+        for st in lbody:
+            visit(st)
+    return findings
+
+
+def _loop_rebinds(loop, lbody) -> set[str]:
+    """Names the loop body rebinds WHOLE (fresh object each iteration)
+    — subscript stores are mutations, not rebindings."""
+    out: set[str] = set()
+    for st in lbody:
+        if isinstance(st, ast.Assign):
+            out |= {t.id for t in st.targets if isinstance(t, ast.Name)}
+            for t in st.targets:
+                if isinstance(t, (ast.Tuple, ast.List)):
+                    out |= {e.id for e in t.elts if isinstance(e, ast.Name)}
+    if isinstance(loop, ast.For):
+        out |= {n.id for n in ast.walk(loop.target)
+                if isinstance(n, ast.Name)}
+    return out
+
+
+def _copy_chain(call: ast.Call) -> bool:
+    """`x.copy()` — result is a fresh ndarray when x is one."""
+    return isinstance(call.func, ast.Attribute) and \
+        call.func.attr == "copy" and not call.args
+
+
+def _aliased_operand(node) -> "tuple[str, int]":
+    """Bare name (or slice view of one) whose memory the handoff can
+    alias.  Copying wrappers and expressions return ('', 0)."""
+    if isinstance(node, ast.Name):
+        return node.id, node.lineno
+    if isinstance(node, ast.Subscript) and isinstance(node.value, ast.Name):
+        return node.value.id, node.lineno       # a view shares memory
+    return "", 0
+
+
+def _mutations(stmt) -> "list[tuple[str, int]]":
+    """(buffer name, line) for in-place writes this statement makes."""
+    out = []
+    tgts = []
+    if isinstance(stmt, ast.Assign):
+        tgts = stmt.targets
+    elif isinstance(stmt, ast.AugAssign):
+        tgts = [stmt.target]
+    for t in tgts:
+        if isinstance(t, ast.Subscript) and isinstance(t.value, ast.Name):
+            out.append((t.value.id, t.lineno))
+        elif isinstance(stmt, ast.AugAssign) and isinstance(t, ast.Name):
+            out.append((t.id, t.lineno))
+    for part in _expr_parts(stmt):
+        for node in ast.walk(part):
+            if not isinstance(node, ast.Call):
+                continue
+            f = node.func
+            if isinstance(f, ast.Attribute) and f.attr in _MUTATORS \
+                    and isinstance(f.value, ast.Name):
+                out.append((f.value.id, node.lineno))
+            d = dotted(f)
+            if d.endswith("copyto") and node.args \
+                    and isinstance(node.args[0], ast.Name):
+                out.append((node.args[0].id, node.lineno))
+    return out

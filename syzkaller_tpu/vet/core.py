@@ -174,11 +174,20 @@ class Report:
         return [f for f in self.findings
                 if f.severity == P0 and not f.baselined]
 
+    @property
+    def p1_unbaselined(self) -> list[Finding]:
+        """The ratchet set: P1s with no baseline entry.  Under
+        `--ratchet` these block like P0s — the tree's P1 count can only
+        go down (or each new one gets a written justification)."""
+        return [f for f in self.findings
+                if f.severity == P1 and not f.baselined]
+
     def counts(self) -> dict:
         out = {"total": len(self.findings),
                "p0": sum(f.severity == P0 for f in self.findings),
                "p1": sum(f.severity == P1 for f in self.findings),
                "p0_unbaselined": len(self.p0_unbaselined),
+               "p1_unbaselined": len(self.p1_unbaselined),
                "baselined": sum(f.baselined for f in self.findings)}
         by_pass: dict[str, int] = {}
         for f in self.findings:
@@ -209,7 +218,8 @@ class Report:
         lines.append(
             f"vet: {c['total']} finding(s) "
             f"({c['p0']} P0, {c['p1']} P1, {c['baselined']} baselined); "
-            f"{c['p0_unbaselined']} unbaselined P0")
+            f"{c['p0_unbaselined']} unbaselined P0, "
+            f"{c['p1_unbaselined']} unbaselined P1")
         return "\n".join(lines)
 
 
@@ -265,14 +275,17 @@ def dotted(node: ast.AST) -> str:
 
 
 def run_passes(files: list[SourceFile], passes=None) -> Report:
-    """Run the given passes (default: all seven) over parsed sources."""
-    from syzkaller_tpu.vet import (hotpath, kernelparity, locks, purity,
-                                   retrace, schema, statslint)
+    """Run the given passes (default: all ten) over parsed sources."""
+    from syzkaller_tpu.vet import (aliasing, donation, epochs, hotpath,
+                                   kernelparity, locks, purity, retrace,
+                                   schema, statslint)
 
     allp = {"lock": locks.check, "purity": purity.check,
             "retrace": retrace.check, "schema": schema.check,
             "stats": statslint.check, "hotpath": hotpath.check,
-            "kernel-parity": kernelparity.check}
+            "kernel-parity": kernelparity.check,
+            "donation": donation.check, "aliasing": aliasing.check,
+            "epoch": epochs.check}
     rep = Report()
     for sf in files:
         if sf.error is not None:

@@ -1,0 +1,293 @@
+"""Donation-flow pass: use-after-donate on jitted-closure operands.
+
+`donate_argnums` hands the operand's device buffer to XLA — after the
+dispatch the Python reference still exists but points at a DELETED
+array, and the first touch raises (or, under some backends, reads
+freed memory).  The engine's idiom makes this safe by construction:
+every donated operand is REBOUND from the closure's return in the same
+statement (`self.max_cover, ... = self._update_fn(self.max_cover,
+...)`).  This pass verifies that idiom holds everywhere:
+
+  * index every jitted def carrying `donate_argnums` (decorator or
+    `jax.jit(f, donate_argnums=...)` form) and every `self._x_fn = f`
+    attribute binding of one — the attr-name index is CROSS-FILE, so a
+    call through `ResilientEngine`'s attr-forwarding seam
+    (`proxy._update_fn(...)`) resolves to the engine's donation spec;
+  * at each call site, map donated positional slots to plain
+    Name / self-attr operand expressions (calls like `jnp.asarray(x)`
+    build fresh temporaries — donation consumes the temp, not x);
+  * flag any later READ of a donated reference in the same function
+    that is not preceded by a rebinding (P0 use-after-donate).  Loop
+    bodies get a second pass so a donation late in iteration N is
+    checked against reads early in iteration N+1.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from syzkaller_tpu.vet.core import P0, Finding, SourceFile, qualname_map
+
+PASS = "donation"
+
+
+def _donate_spec(deco: ast.AST) -> "tuple[int, ...] | None":
+    """donate_argnums tuple from a `functools.partial(jax.jit, ...)` /
+    `jax.jit(..., donate_argnums=...)` decorator or call, else None."""
+    if not isinstance(deco, ast.Call):
+        return None
+    from syzkaller_tpu.vet.core import dotted
+    head = dotted(deco.func)
+    is_partial_jit = head.endswith("partial") and any(
+        dotted(a).endswith("jit") for a in deco.args)
+    is_jit = head.endswith("jit") or head == "jit"
+    if not (is_partial_jit or is_jit):
+        return None
+    for kw in deco.keywords:
+        if kw.arg == "donate_argnums":
+            v = kw.value
+            if isinstance(v, (ast.Tuple, ast.List)):
+                out = []
+                for e in v.elts:
+                    if isinstance(e, ast.Constant) and isinstance(e.value,
+                                                                  int):
+                        out.append(e.value)
+                return tuple(out)
+            if isinstance(v, ast.Constant) and isinstance(v.value, int):
+                return (v.value,)
+    return None
+
+
+def _operand_name(node: ast.AST) -> str:
+    """Dotted name of a donate-trackable operand: a plain Name or a
+    Name-rooted attribute chain.  '' for anything that builds a fresh
+    value (calls, subscripts, literals) — donation consumes the temp."""
+    if isinstance(node, ast.Name):
+        return node.id
+    if isinstance(node, ast.Attribute):
+        base = _operand_name(node.value)
+        return f"{base}.{node.attr}" if base else ""
+    return ""
+
+
+class _Index:
+    """Cross-file map: donating callee names → donated argnums."""
+
+    def __init__(self, files: list[SourceFile]):
+        # local def name per file isn't needed cross-file; attr names are
+        self.attrs: dict[str, tuple[int, ...]] = {}
+        for sf in files:
+            for fdef, spec in _file_defs(sf.tree).items():
+                for attr in _attr_bindings(sf.tree, fdef.name):
+                    prev = self.attrs.get(attr, ())
+                    self.attrs[attr] = tuple(sorted(set(prev) | set(spec)))
+
+
+def _file_defs(tree) -> "dict[ast.FunctionDef, tuple[int, ...]]":
+    out = {}
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            for deco in node.decorator_list:
+                spec = _donate_spec(deco)
+                if spec:
+                    out[node] = spec
+    return out
+
+
+def _attr_bindings(tree, fname: str) -> list[str]:
+    """Attr names bound to the donating def: `self.X = fname` (or any
+    receiver — the binding names the forwarding surface)."""
+    out = []
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Assign) and \
+                isinstance(node.value, ast.Name) and node.value.id == fname:
+            for tgt in node.targets:
+                if isinstance(tgt, ast.Attribute):
+                    out.append(tgt.attr)
+    return out
+
+
+def _jit_assigns(tree) -> dict[str, tuple[int, ...]]:
+    """`g = jax.jit(f, donate_argnums=...)` name bindings."""
+    out = {}
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Assign) and isinstance(node.value, ast.Call):
+            spec = _donate_spec(node.value)
+            if spec:
+                for tgt in node.targets:
+                    if isinstance(tgt, ast.Name):
+                        out[tgt.id] = spec
+    return out
+
+
+def _stmts(body):
+    """Statements in source order, descending into compound bodies.
+    Yields (stmt, loop_depth)."""
+    for st in body:
+        yield st, 0
+        for blk in ("body", "orelse", "finalbody"):
+            inner = getattr(st, blk, None)
+            if inner and not isinstance(st, (ast.FunctionDef,
+                                             ast.AsyncFunctionDef,
+                                             ast.ClassDef)):
+                bump = 1 if isinstance(st, (ast.For, ast.While)) \
+                    and blk == "body" else 0
+                for s, d in _stmts(inner):
+                    yield s, d + bump
+        for h in getattr(st, "handlers", []):
+            for s, d in _stmts(h.body):
+                yield s, d
+
+
+_COMPOUND = (ast.If, ast.For, ast.While, ast.With, ast.Try)
+
+
+def _expr_parts(stmt) -> list:
+    """The expressions a yielded statement evaluates ITSELF — compound
+    statements contribute only their header (test/iter/with-items);
+    their bodies are yielded as separate statements by `_stmts`."""
+    if isinstance(stmt, (ast.If, ast.While)):
+        return [stmt.test]
+    if isinstance(stmt, ast.For):
+        return [stmt.iter]
+    if isinstance(stmt, ast.With):
+        return [it.context_expr for it in stmt.items]
+    if isinstance(stmt, ast.Try):
+        return []
+    return [stmt]
+
+
+def _targets(stmt) -> set[str]:
+    out: set[str] = set()
+    tgts = []
+    if isinstance(stmt, ast.Assign):
+        tgts = stmt.targets
+    elif isinstance(stmt, (ast.AugAssign, ast.AnnAssign)):
+        tgts = [stmt.target]
+    elif isinstance(stmt, ast.For):
+        tgts = [stmt.target]
+    for t in tgts:
+        for el in ast.walk(t):
+            nm = _operand_name(el)
+            if nm:
+                out.add(nm)
+    return out
+
+
+def _reads(stmt) -> "list[tuple[str, int]]":
+    """Dotted names READ by this statement (load context), with lines."""
+    out = []
+    for part in _expr_parts(stmt):
+        for node in ast.walk(part):
+            if isinstance(node, (ast.Name, ast.Attribute)) and \
+                    isinstance(getattr(node, "ctx", None), ast.Load):
+                nm = _operand_name(node)
+                if nm:
+                    out.append((nm, node.lineno))
+    return out
+
+
+def _donations(stmt, known_local, known_attr) -> "list[tuple[str, int]]":
+    """(donated dotted name, line) for every donating call in stmt."""
+    out = []
+    for part in _expr_parts(stmt):
+        nodes = list(ast.walk(part))
+        out.extend(_donations_in(nodes, known_local, known_attr))
+    return out
+
+
+def _donations_in(nodes, known_local, known_attr):
+    out = []
+    for node in nodes:
+        if not isinstance(node, ast.Call):
+            continue
+        spec = None
+        if isinstance(node.func, ast.Name):
+            spec = known_local.get(node.func.id)
+        elif isinstance(node.func, ast.Attribute):
+            spec = known_attr.get(node.func.attr)
+        if not spec:
+            continue
+        for i in spec:
+            if i < len(node.args):
+                nm = _operand_name(node.args[i])
+                if nm:
+                    out.append((nm, node.lineno))
+    return out
+
+
+def check(files: list[SourceFile]) -> list[Finding]:
+    idx = _Index(files)
+    out: list[Finding] = []
+    for sf in files:
+        known_local: dict[str, tuple[int, ...]] = {
+            f.name: spec for f, spec in _file_defs(sf.tree).items()}
+        known_local.update(_jit_assigns(sf.tree))
+        if not known_local and not any(
+                isinstance(n, ast.Attribute) and n.attr in idx.attrs
+                for n in ast.walk(sf.tree)):
+            continue
+        qmap = qualname_map(sf.tree)
+        for node, qual in qmap.items():
+            if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            out.extend(_scan_fn(sf, node, qual, known_local, idx.attrs))
+    return out
+
+
+def _scan_fn(sf, fn, qual, known_local, known_attr) -> list[Finding]:
+    body = [st for st, _ in _stmts(fn.body)
+            if not isinstance(st, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                   ast.ClassDef))]
+    events = []                      # (stmt, donations, targets)
+    for st in body:
+        don = _donations(st, known_local, known_attr)
+        events.append((st, don, _targets(st)))
+    findings = []
+    tainted: dict[str, int] = {}     # name -> donation line
+
+    def visit(st, don, tgts):
+        for nm, ln in _reads(st):
+            dl = tainted.get(nm)
+            if dl is not None:
+                findings.append(Finding(
+                    pass_name=PASS, rule="use-after-donate", severity=P0,
+                    path=sf.path, line=ln, scope=qual,
+                    message=(f"`{nm}` was passed in a donated slot at "
+                             f"line {dl}; its buffer belongs to XLA now "
+                             "— this read touches a deleted array"),
+                    hint="rebind the name from the dispatch result "
+                         "(donated-carry idiom) or pass a fresh copy",
+                    detail=nm))
+                tainted.pop(nm, None)    # one report per donation
+        for nm, ln in don:
+            tainted[nm] = ln
+        for nm in tgts:
+            tainted.pop(nm, None)
+            # rebinding `x` also refreshes `x.attr` taints rooted at it
+            for t in [t for t in tainted if t.startswith(nm + ".")]:
+                tainted.pop(t, None)
+
+    for st, don, tgts in events:
+        visit(st, don, tgts)
+    # loop-carried pass: a donation late in iteration N taints reads
+    # early in iteration N+1 unless the loop body rebinds the name
+    for loop in ast.walk(fn):
+        if not isinstance(loop, (ast.For, ast.While)):
+            continue
+        lbody = [st for st, _ in _stmts(loop.body)
+                 if not isinstance(st, (ast.FunctionDef,
+                                        ast.AsyncFunctionDef, ast.ClassDef))]
+        rebound: set[str] = set()
+        for st in lbody:
+            rebound |= _targets(st)
+        if isinstance(loop, ast.For):
+            rebound |= _targets(loop)
+        tainted.clear()
+        for st in lbody:
+            for nm, ln in _donations(st, known_local, known_attr):
+                if nm not in rebound:
+                    tainted[nm] = ln
+        for st in lbody:
+            visit(st, [], set())
+    return findings

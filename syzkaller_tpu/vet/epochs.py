@@ -1,0 +1,248 @@
+"""Epoch-staleness pass: consumers of epoch-guarded state must check
+the epoch before use.
+
+The decision/synth streams invalidate cached device draws by bumping
+an epoch (`DecisionStream.invalidate`) — every banking path is
+required to snapshot the epoch BEFORE the dispatch and compare before
+publishing, otherwise pre-invalidation draws leak back into the rings
+after invalidate() returned (stale decisions steer the fuzzer with a
+dead priority matrix).  Four rules, all P1:
+
+  * `feed-missing-epoch` — a `.feed(prev, draws)` call without the
+    `epoch=` snapshot: the callee cannot reject stale draws it cannot
+    date;
+  * `bank-after-dispatch` — a method of an epoch-guarded class (one
+    that assigns `self._epoch`) that dispatches device work and then
+    extends self-rooted ring/queue state with no `_epoch` comparison
+    anywhere in its body;
+  * `swap-without-invalidate` — overlay swaps and `rebind*` re-uploads
+    in an epoch-guarded class that never call `invalidate()`/bump the
+    epoch: cached draws from the old distribution survive the swap;
+  * `resolve-reads-live-table` — in a class with a `snapshot()`
+    method, a `resolve*` method reading the live table attrs snapshot
+    captures instead of the ticket's submit-time copy (a FIFO
+    replacement racing the resolve misattributes provenance).
+"""
+
+from __future__ import annotations
+
+import ast
+
+from syzkaller_tpu.vet.core import P1, Finding, SourceFile, dotted, \
+    enclosing_scope
+from syzkaller_tpu.vet.donation import _expr_parts, _stmts
+
+PASS = "epoch"
+
+# device-dispatch shapes inside stream classes: engine calls and
+# jitted-closure calls
+_DISPATCH_SUFFIX = ("_fn",)
+_DISPATCH_METHODS = {"decision_block", "synth_block", "sample_next_calls",
+                     "random_words", "put_replicated", "put_row_sharded",
+                     "update_batch", "fuzz_tick", "admit_slabs", "dispatch"}
+_BANK_METHODS = {"extend", "append", "appendleft", "setdefault"}
+
+
+def check(files: list[SourceFile]) -> list[Finding]:
+    out: list[Finding] = []
+    for sf in files:
+        for node in ast.walk(sf.tree):
+            if isinstance(node, ast.ClassDef):
+                out.extend(_scan_class(sf, node))
+        out.extend(_scan_feeds(sf))
+    return out
+
+
+# -- rule: feed-missing-epoch ----------------------------------------------
+
+
+def _scan_feeds(sf) -> list[Finding]:
+    out = []
+    for node in ast.walk(sf.tree):
+        if not (isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr == "feed"):
+            continue
+        if len(node.args) < 2:
+            continue                       # not the (prev, draws) shape
+        if len(node.args) >= 3 or any(kw.arg == "epoch"
+                                      for kw in node.keywords):
+            continue
+        out.append(Finding(
+            pass_name=PASS, rule="feed-missing-epoch", severity=P1,
+            path=sf.path, line=node.lineno,
+            scope=enclosing_scope(sf.tree, node),
+            message="feed() banks externally drawn decisions without an "
+                    "epoch snapshot — an invalidate() racing the "
+                    "dispatch cannot reject these stale draws",
+            hint="snapshot stream.epoch() before dispatching and pass "
+                 "feed(..., epoch=snap)",
+            detail=dotted(node.func)))
+    return out
+
+
+# -- epoch-guarded class rules ---------------------------------------------
+
+
+def _scan_class(sf, cls) -> list[Finding]:
+    methods = [n for n in cls.body
+               if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))]
+    has_epoch = any(_writes_attr(m, "_epoch") for m in methods)
+    has_snapshot = any(m.name == "snapshot" for m in methods)
+    out: list[Finding] = []
+    if has_epoch:
+        for m in methods:
+            if m.name == "__init__" or _mentions_epoch(m):
+                continue
+            out.extend(_rule_bank_after_dispatch(sf, cls, m))
+            out.extend(_rule_swap_without_invalidate(sf, cls, m))
+    if has_snapshot:
+        snap = next(m for m in methods if m.name == "snapshot")
+        live = _self_attr_reads(snap) - {"_mu"}
+        for m in methods:
+            if m.name.startswith("resolve") and m.name != "snapshot":
+                out.extend(_rule_resolve_live(sf, cls, m, live))
+    return out
+
+
+def _writes_attr(fn, attr: str) -> bool:
+    for node in ast.walk(fn):
+        if isinstance(node, (ast.Assign, ast.AugAssign, ast.AnnAssign)):
+            tgts = node.targets if isinstance(node, ast.Assign) \
+                else [node.target]
+            for t in tgts:
+                if isinstance(t, ast.Attribute) and t.attr == attr and \
+                        dotted(t.value) == "self":
+                    return True
+    return False
+
+
+def _mentions_epoch(fn) -> bool:
+    """The method dates its work: it compares/snapshots an epoch (or
+    delegates by calling invalidate(), which bumps it)."""
+    for node in ast.walk(fn):
+        if isinstance(node, (ast.Name, ast.Attribute)):
+            nm = dotted(node)
+            if nm and "epoch" in nm.split(".")[-1].lower():
+                return True
+        if isinstance(node, ast.Call) and \
+                dotted(node.func).endswith("invalidate"):
+            return True
+        if isinstance(node, ast.arg) and "epoch" in node.arg:
+            return True
+    return False
+
+
+def _is_dispatch(call: ast.Call) -> bool:
+    f = call.func
+    if not isinstance(f, ast.Attribute):
+        return False
+    return f.attr.endswith(_DISPATCH_SUFFIX) or f.attr in _DISPATCH_METHODS
+
+
+def _rule_bank_after_dispatch(sf, cls, fn) -> list[Finding]:
+    """Dispatch, then bank into self-rooted rings, never comparing the
+    epoch: stale draws survive an invalidate that raced the dispatch."""
+    body = [st for st, _ in _stmts(fn.body)
+            if not isinstance(st, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                   ast.ClassDef))]
+    self_vars = {"self"}                  # locals aliasing self state
+    dispatched_at = None
+    for st in body:
+        for part in _expr_parts(st):
+            for node in ast.walk(part):
+                if isinstance(node, ast.Call) and _is_dispatch(node):
+                    dispatched_at = dispatched_at or node.lineno
+        if isinstance(st, ast.Assign) and isinstance(st.value, ast.Call) \
+                and isinstance(st.value.func, ast.Attribute) \
+                and dotted(st.value.func).startswith("self."):
+            # q = self._rings.setdefault(...) — q aliases ring state
+            for t in st.targets:
+                if isinstance(t, ast.Name):
+                    self_vars.add(t.id)
+        if dispatched_at is None:
+            continue
+        for part in _expr_parts(st):
+            for node in ast.walk(part):
+                if isinstance(node, ast.Call) \
+                        and isinstance(node.func, ast.Attribute) \
+                        and node.func.attr in _BANK_METHODS \
+                        and node.lineno > dispatched_at:
+                    root = dotted(node.func.value).split(".")[0]
+                    if root in self_vars:
+                        return [Finding(
+                            pass_name=PASS, rule="bank-after-dispatch",
+                            severity=P1, path=sf.path, line=node.lineno,
+                            scope=f"{cls.name}.{fn.name}",
+                            message=(f"{fn.name} banks draws into "
+                                     "ring state after a device "
+                                     "dispatch without comparing the "
+                                     "epoch — an invalidate() racing "
+                                     "the dispatch leaves stale draws "
+                                     "in the ring"),
+                            hint="snapshot self._epoch before the "
+                                 "dispatch and discard when it moved",
+                            detail=fn.name)]
+    return []
+
+
+def _rule_swap_without_invalidate(sf, cls, fn) -> list[Finding]:
+    """Overlay swaps / rebind re-uploads must ride the epoch path."""
+    is_rebind = fn.name.startswith("rebind")
+    swaps_overlay = any(
+        isinstance(t, ast.Attribute) and "overlay" in t.attr
+        and dotted(t.value) == "self"
+        for node in ast.walk(fn)
+        if isinstance(node, ast.Assign) for t in node.targets)
+    if not (is_rebind or swaps_overlay):
+        return []
+    # caller already established the method never mentions the epoch
+    # family (invalidate()/_epoch/epoch args) — so the swap is unguarded
+    what = "rebinds cached device operands" if is_rebind \
+        else "swaps the campaign overlay"
+    return [Finding(
+        pass_name=PASS, rule="swap-without-invalidate", severity=P1,
+        path=sf.path, line=fn.lineno, scope=f"{cls.name}.{fn.name}",
+        message=(f"{fn.name} {what} without invalidate()/an epoch bump "
+                 "— draws cached under the old operands survive the "
+                 "swap and steer consumers with a dead distribution"),
+        hint="call self.invalidate() after installing the new operands",
+        detail=fn.name)]
+
+
+def _self_attr_reads(fn) -> set[str]:
+    out = set()
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Attribute) and dotted(node.value) == "self":
+            out.add(node.attr)
+    return out
+
+
+def _rule_resolve_live(sf, cls, fn, live: set[str]) -> list[Finding]:
+    out = []
+    # a subscripted self-table read (`self._h[...]`) in a resolver is a
+    # live read even when snapshot() forgot to capture that table —
+    # forgetting it is exactly the bug
+    subscripted = {
+        node.value.attr for node in ast.walk(fn)
+        if isinstance(node, ast.Subscript)
+        and isinstance(node.value, ast.Attribute)
+        and dotted(node.value.value) == "self"}
+    live = live | subscripted
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Attribute) and node.attr in live and \
+                dotted(node.value) == "self" and \
+                isinstance(node.ctx, ast.Load):
+            out.append(Finding(
+                pass_name=PASS, rule="resolve-reads-live-table",
+                severity=P1, path=sf.path, line=node.lineno,
+                scope=f"{cls.name}.{fn.name}",
+                message=(f"{fn.name} reads live table state "
+                         f"`self.{node.attr}` that snapshot() exists to "
+                         "freeze — a table replacement racing this "
+                         "resolve misattributes the result"),
+                hint="read it from the ticket's submit-time snapshot "
+                     "instead",
+                detail=node.attr))
+            break                            # one finding per method
+    return out

@@ -1,0 +1,321 @@
+"""syz-san runtime-plane tests: the live-object half of the lifetime
+sanitizer.
+
+The detection matrix (tentpole acceptance): each seeded bug class is
+caught by the runtime plane AND has a clean twin that stays quiet —
+
+  * use-after-donate      — reuse-without-rebind raises at the next
+                            dispatch; an unrebound engine attr is
+                            poisoned and the first touch raises
+  * alias-then-mutate     — the PR-15 reconstruction: a host buffer
+                            mutated between submit and resolve trips
+                            the generation check with both stacks
+  * stale-epoch feed      — draws dated with a pre-invalidate epoch
+                            are discarded, current-epoch draws bank
+
+plus the opt-in contract (SYZ_SAN=0 wraps nothing), composition with
+the dispatch profiler in either order, and the lockset audit.  The
+static twins of the same matrix live in tests/test_vet.py.
+"""
+
+import threading
+
+import numpy as np
+import pytest
+
+from syzkaller_tpu import san
+from syzkaller_tpu.san.report import Report
+from syzkaller_tpu.san.shadow import ShadowChecker
+
+
+class FakeDonatingEngine:
+    """Minimal engine surface: one donating dispatch attr (argnum 0)
+    and one non-donating one, both in the profiler's DISPATCH_ATTRS."""
+
+    def __init__(self):
+        self.max_cover = np.zeros(16, np.uint32)
+        self._update_fn = lambda cover, rows: np.asarray(cover) | rows
+        self._decision_fn = lambda key: np.zeros(4, np.int64)
+
+
+SPECS = {"_update_fn": (0,)}
+
+
+def checker():
+    rep = Report()
+    return ShadowChecker(rep, specs=SPECS), rep
+
+
+# -- matrix row 1: use-after-donate ------------------------------------------
+
+
+def test_donate_reuse_without_rebind_raises():
+    eng = FakeDonatingEngine()
+    chk, rep = checker()
+    chk.attach(eng)
+    buf = np.ones(16, np.uint32)
+    eng._update_fn(buf, np.ones(16, np.uint32))
+    with pytest.raises(san.UseAfterDonateError, match="without a rebind"):
+        eng._update_fn(buf, np.ones(16, np.uint32))
+    assert rep.counts().get("use-after-donate") == 1
+
+
+def test_donate_unrebound_attr_poisoned():
+    eng = FakeDonatingEngine()
+    chk, rep = checker()
+    chk.attach(eng)
+    # donate the buffer the engine attr still references, never rebind
+    eng._update_fn(eng.max_cover, np.ones(16, np.uint32))
+    # the sweep runs at the NEXT dispatch (the donated-carry rebind
+    # happens after the wrapper returns, so poisoning any earlier
+    # would flag correct code)
+    eng._decision_fn(np.zeros(2, np.uint32))
+    assert rep.counts().get("donated-ref-unrebound") == 1
+    with pytest.raises(san.UseAfterDonateError, match="never rebound"):
+        eng.max_cover.sum()
+    # a poisoned operand is refused at the dispatch boundary too
+    eng2 = FakeDonatingEngine()
+    with pytest.raises(san.UseAfterDonateError, match="poisoned"):
+        san.check_operands([eng2.max_cover, eng.max_cover], "update")
+
+
+def test_donate_carry_clean_twin_quiet():
+    eng = FakeDonatingEngine()
+    chk, rep = checker()
+    chk.attach(eng)
+    for _ in range(4):
+        # the donated-carry idiom: rebind from the dispatch result in
+        # the same statement, then the next dispatch sweeps clean
+        eng.max_cover = eng._update_fn(
+            eng.max_cover, np.ones(16, np.uint32))
+    eng._decision_fn(np.zeros(2, np.uint32))
+    assert rep.total == 0
+    assert isinstance(eng.max_cover, np.ndarray)
+
+
+def test_real_engine_specs_cover_donating_closures():
+    """The runtime plane derives its donation specs from the SAME ast
+    index the static pass uses over cover/engine.py — drift-proof."""
+    from syzkaller_tpu.san.shadow import _donation_specs
+
+    specs = _donation_specs()
+    assert specs.get("_update_fn") == (0,)
+    assert specs.get("_fuzz_tick_fn") == (0, 1, 2)
+    assert all(a.endswith("_fn") for a in specs)
+    assert len(specs) >= 10
+
+
+# -- matrix row 2: alias-then-mutate (PR-15 reconstruction) ------------------
+
+
+def test_generation_mutation_in_flight_raises():
+    win = np.arange(64, dtype=np.uint32)
+    tok = san.stamp(win, "slab win")
+    win[3] = 0xdead                     # host write while "in flight"
+    with pytest.raises(san.MutationInFlightError, match="slab win"):
+        san.verify(tok)
+
+
+def test_generation_clean_twin_quiet():
+    win = np.arange(64, dtype=np.uint32)
+    tok = san.stamp(win, "slab win")
+    copy = win.copy()
+    copy[3] = 0xdead                    # the fix idiom: mutate a copy
+    san.verify(tok)
+    assert san.stamp(None, "x") is None         # non-ndarray: no token
+    san.verify(None)                            # and verify is a no-op
+
+
+def test_device_signal_tick_catches_inflight_mutation(monkeypatch):
+    """The integration twin: DeviceSignal stamps the tick window at
+    submit and verifies at resolve — mutating between the two is the
+    exact PR-15 bug and must be a hard error."""
+    monkeypatch.setenv("SYZ_SAN", "1")
+    from syzkaller_tpu.fuzzer.device_signal import DeviceSignal
+
+    sig = DeviceSignal(ncalls=8, npcs=1 << 13, flush_batch=4, max_pcs=16)
+    rng = np.random.default_rng(5)
+
+    def tick():
+        win = rng.integers(1, 1 << 20, (4, 16)).astype(np.uint32)
+        counts = rng.integers(1, 16, (4,)).astype(np.int32)
+        cids = rng.integers(0, 8, (4,)).astype(np.int32)
+        ticket, _res = sig.submit_tick(win, counts, cids)
+        return ticket, win
+
+    ticket, win = tick()                # clean: resolve verifies quiet
+    sig.resolve(ticket)
+    ticket, win = tick()
+    win[0, 0] ^= 0x1                    # seeded: mutate in flight
+    with pytest.raises(san.MutationInFlightError):
+        sig.resolve(ticket)
+
+
+def test_device_signal_unarmed_no_tokens():
+    from syzkaller_tpu.fuzzer.device_signal import DeviceSignal
+
+    sig = DeviceSignal(ncalls=8, npcs=1 << 13, flush_batch=4, max_pcs=16)
+    win = np.ones((4, 16), np.uint32)
+    counts = np.full(4, 16, np.int32)
+    cids = np.zeros(4, np.int32)
+    ticket, _res = sig.submit_tick(win, counts, cids)
+    assert ticket[-1] is None           # unarmed: no stamp, zero cost
+    win[0, 0] = 7                       # and no verification either
+    sig.resolve(ticket)
+
+
+# -- matrix row 3: stale-epoch feed ------------------------------------------
+
+
+def test_stale_epoch_feed_discarded():
+    from syzkaller_tpu.cover.engine import CoverageEngine
+    from syzkaller_tpu.fuzzer.device_ct import DecisionStream
+
+    eng = CoverageEngine(npcs=1 << 10, ncalls=8, corpus_cap=64,
+                         batch=4, max_pcs_per_exec=16)
+    ds = DecisionStream(eng, per_row=8, hot_slots=64, corpus_rows=32,
+                        entropy_words=1024, autostart=False)
+    try:
+        ep = ds.epoch()
+        draws = np.arange(4, dtype=np.int64)
+        assert ds.feed(-1, draws, epoch=ep) > 0     # clean twin banks
+        before = ds.stat_discarded
+        ds.invalidate()                 # epoch bump races the dispatch
+        assert ds.feed(-1, draws, epoch=ep) == 0    # stale: discarded
+        assert ds.stat_discarded == before + 1
+        assert ds.feed(-1, draws, epoch=ds.epoch()) > 0
+    finally:
+        ds.stop()
+
+
+# -- opt-in contract ---------------------------------------------------------
+
+
+def test_unarmed_attach_is_noop(monkeypatch):
+    monkeypatch.setenv("SYZ_SAN", "0")
+    eng = FakeDonatingEngine()
+    before = eng._update_fn
+    assert san.attach(eng) == []
+    assert eng._update_fn is before     # nothing wrapped
+    assert san.summary()["armed"] is False
+
+
+def test_armed_engine_self_arms_on_build(monkeypatch):
+    monkeypatch.setenv("SYZ_SAN", "1")
+    from syzkaller_tpu.cover.engine import CoverageEngine
+
+    total0 = san.report.total
+    eng = CoverageEngine(npcs=1 << 10, ncalls=8, corpus_cap=64,
+                         batch=4, max_pcs_per_exec=16)
+    assert getattr(eng._update_fn, "_syz_san", None) is not None
+    # a clean admission storm through the armed engine: zero findings
+    rng = np.random.default_rng(9)
+    for _ in range(4):
+        idx = rng.integers(0, 1 << 10, (4, 16)).astype(np.int32)
+        valid = np.ones((4, 16), bool)
+        cids = rng.integers(0, 8, (4,)).astype(np.int32)
+        res = eng.update_batch(cids, idx, valid)
+        rows = np.nonzero(res.has_new)[0]
+        if len(rows):
+            eng.admit_rows(res, cids, rows)
+    assert san.report.total == total0
+
+
+# -- profiler composition ----------------------------------------------------
+
+
+def test_composes_with_profiler_either_order():
+    from syzkaller_tpu.observe import DispatchProfiler
+
+    for san_first in (False, True):
+        eng = FakeDonatingEngine()
+        chk, rep = checker()
+        prof = DispatchProfiler()
+        if san_first:
+            chk.attach(eng)
+            prof.attach(eng)
+        else:
+            prof.attach(eng)
+            chk.attach(eng)
+        eng.max_cover = eng._update_fn(
+            eng.max_cover, np.ones(16, np.uint32))
+        snap = prof.snapshot()["dispatches"]
+        assert snap["update"]["count"] == 1, f"san_first={san_first}"
+        assert rep.total == 0
+        # both attaches are idempotent over the composed stack
+        chk.attach(eng)
+        prof.attach(eng)
+        eng.max_cover = eng._update_fn(
+            eng.max_cover, np.ones(16, np.uint32))
+        assert prof.snapshot()["dispatches"]["update"]["count"] == 2
+
+
+# -- lockset audit -----------------------------------------------------------
+
+
+class _Locked:
+    def __init__(self):
+        self._mu = threading.Lock()
+        self._state_mu = threading.Lock()
+
+
+def test_dispatch_under_foreign_lock_raises():
+    from syzkaller_tpu.san.lockset import LocksetAudit
+
+    rep = Report()
+    audit = LocksetAudit(rep)
+    owner = _Locked()
+    audit.wrap(owner, "_mu", "test._mu")
+    with owner._mu:
+        with pytest.raises(san.LockAuditError, match="test._mu"):
+            audit.on_dispatch("update")
+    assert rep.counts().get("dispatch-under-lock") == 1
+    audit.on_dispatch("update")         # released: clean
+
+
+def test_allow_dispatch_lock_passes():
+    from syzkaller_tpu.san.lockset import LocksetAudit
+
+    rep = Report()
+    audit = LocksetAudit(rep)
+    owner = _Locked()
+    audit.wrap(owner, "_state_mu", "engine._state_mu",
+               allow_dispatch=True)
+    with owner._state_mu:               # the documented donated-carry
+        audit.on_dispatch("update")     # serialization exception
+    assert rep.total == 0
+    # wrap is idempotent: re-attach must not double-wrap
+    lk = owner._state_mu
+    assert audit.wrap(owner, "_state_mu", "engine._state_mu",
+                      allow_dispatch=True) is lk
+
+
+def test_lock_order_inversion_recorded_not_raised():
+    from syzkaller_tpu.san.lockset import LocksetAudit
+
+    rep = Report()
+    audit = LocksetAudit(rep)
+    owner = _Locked()
+    a = audit.wrap(owner, "_mu", "A")
+    b = audit.wrap(owner, "_state_mu", "B")
+    with a:
+        with b:
+            pass
+    with b:
+        with a:                         # reverse order: deadlock risk
+            pass
+    assert rep.counts().get("lock-order") == 1
+
+
+# -- SanError never absorbed by failover -------------------------------------
+
+
+def test_san_errors_outside_supervisor_fault_types():
+    """The resilience plane retries RuntimeError-family backend faults;
+    sanitizer findings must never ride that path (a failover would
+    silently swallow a real lifetime bug)."""
+    from syzkaller_tpu.resilience.supervisor import FAULT_TYPES
+
+    for exc in (san.SanError, san.UseAfterDonateError,
+                san.MutationInFlightError, san.LockAuditError):
+        assert not issubclass(exc, FAULT_TYPES), exc

@@ -899,6 +899,252 @@ def test_pallas_clean_body_quiet():
     assert run(PALLAS_CLEAN, ["hotpath"], path="somewhere/else.py") == []
 
 
+# -- pass 8: donation flow (use-after-donate) --------------------------------
+
+
+DONATION_ENGINE = """
+from functools import partial
+import jax
+
+@partial(jax.jit, donate_argnums=(0,))
+def _update(cover, rows):
+    return cover
+
+class Engine:
+    def __init__(self):
+        self._update_fn = _update
+"""
+
+DONATION_SEEDED = DONATION_ENGINE + """
+    def step(self, rows):
+        out = self._update_fn(self.max_cover, rows)
+        return self.max_cover.sum()     # reads the deleted buffer
+"""
+
+DONATION_CLEAN = DONATION_ENGINE + """
+    def step(self, rows):
+        # donated-carry: rebind from the dispatch result
+        self.max_cover = self._update_fn(self.max_cover, rows)
+        return self.max_cover.sum()
+"""
+
+
+def test_donation_use_after_donate_caught():
+    f = run(DONATION_SEEDED, ["donation"], path="cover/engine.py")
+    assert rules(f) == {"use-after-donate"}
+    assert all(x.severity == vet.P0 for x in f)
+    assert any("self.max_cover" in x.message for x in f)
+
+
+def test_donation_carry_rebind_clean():
+    assert run(DONATION_CLEAN, ["donation"], path="cover/engine.py") == []
+
+
+def test_donation_cross_file_forwarding_seam():
+    """The attr index is CROSS-FILE: a call through the resilience
+    proxy's attr-forwarding seam resolves to the engine's donation
+    spec defined in another file."""
+    eng = vet.from_source(textwrap.dedent(DONATION_ENGINE),
+                          "cover/engine.py")
+    proxy = vet.from_source(textwrap.dedent("""
+        class Resilient:
+            def step(self, proxy, cover, rows):
+                proxy._update_fn(cover, rows)
+                return cover.sum()
+        """), "resilience/supervisor.py")
+    f = core.run_passes([eng, proxy], passes=["donation"]).findings
+    assert any(x.rule == "use-after-donate"
+               and x.path == "resilience/supervisor.py" for x in f)
+
+
+def test_donation_loop_carried_taint():
+    # donation late in iteration N, read early in iteration N+1
+    src = DONATION_ENGINE + """
+    def storm(self, batches):
+        buf = batches[0]
+        for rows in batches:
+            total = buf.sum()
+            self._update_fn(buf, rows)
+"""
+    f = run(src, ["donation"], path="cover/engine.py")
+    assert "use-after-donate" in rules(f)
+    fixed = src.replace("self._update_fn(buf, rows)",
+                        "buf = self._update_fn(buf, rows)")
+    assert run(fixed, ["donation"], path="cover/engine.py") == []
+
+
+def test_donation_fresh_temp_not_tainted():
+    # jnp.asarray(x) builds a temp — donation consumes the temp, not x
+    src = DONATION_ENGINE + """
+    def step(self, jnp, rows):
+        self._update_fn(jnp.asarray(self.max_cover), rows)
+        return self.max_cover.sum()
+"""
+    assert run(src, ["donation"], path="cover/engine.py") == []
+
+
+# -- pass 9: host aliasing (mutate-after-handoff, the PR-15 bug) -------------
+
+
+ALIAS_SEEDED = """
+import numpy as np
+import jax.numpy as jnp
+
+class Signal:
+    def submit(self):
+        win = np.zeros((8, 32), np.uint32)
+        self._dev = jnp.asarray(win)
+        win[0, 0] = 1        # dispatch may read this FUTURE value
+        return self._dev
+"""
+
+ALIAS_CLEAN_COPY = """
+import numpy as np
+import jax.numpy as jnp
+
+class Signal:
+    def submit(self):
+        win = np.zeros((8, 32), np.uint32)
+        self._dev = jnp.asarray(win.copy())    # the shipped fix
+        win[0, 0] = 1
+        return self._dev
+"""
+
+ALIAS_CLEAN_SYNC = """
+import numpy as np
+import jax.numpy as jnp
+
+class Signal:
+    def submit(self):
+        win = np.zeros((8, 32), np.uint32)
+        self._dev = jnp.asarray(win)
+        total = np.asarray(self._dev).sum()    # host sync materializes
+        win[0, 0] = 1                          # buffer is ours again
+        return total
+"""
+
+
+def test_aliasing_pr15_mutation_caught():
+    f = run(ALIAS_SEEDED, ["aliasing"], path="fuzzer/device_signal.py")
+    assert rules(f) == {"mutate-after-handoff"}
+    assert all(x.severity == vet.P1 for x in f)
+    assert any("win" == x.detail for x in f)
+
+
+def test_aliasing_copy_handoff_clean():
+    assert run(ALIAS_CLEAN_COPY, ["aliasing"],
+               path="fuzzer/device_signal.py") == []
+
+
+def test_aliasing_sync_clears_taint():
+    assert run(ALIAS_CLEAN_SYNC, ["aliasing"],
+               path="fuzzer/device_signal.py") == []
+
+
+def test_aliasing_loop_carried_double_buffer():
+    # handoff late in iteration N, mutate early in N+1 — the
+    # double-buffered-ring shape; rebinding each iteration is the fix
+    src = """
+import numpy as np
+import jax.numpy as jnp
+
+class Ring:
+    def pump(self, eng, n):
+        win = np.zeros((8, 32), np.uint32)
+        for i in range(n):
+            win[0, 0] = i
+            eng.put_replicated(win)
+"""
+    f = run(src, ["aliasing"], path="fuzzer/device_signal.py")
+    assert "mutate-after-handoff" in rules(f)
+    fixed = src.replace("win[0, 0] = i",
+                        "win = np.zeros((8, 32), np.uint32)")
+    assert run(fixed, ["aliasing"], path="fuzzer/device_signal.py") == []
+
+
+# -- pass 10: epoch staleness ------------------------------------------------
+
+
+def test_epoch_feed_missing_snapshot_caught():
+    src = """
+class Caller:
+    def tick(self, stream, draws):
+        stream.feed(-1, draws)
+"""
+    f = run(src, ["epoch"])
+    assert rules(f) == {"feed-missing-epoch"}
+    clean = src.replace("stream.feed(-1, draws)",
+                        "ep = stream.epoch()\n"
+                        "        stream.feed(-1, draws, epoch=ep)")
+    assert run(clean, ["epoch"]) == []
+
+
+EPOCH_CLASS = """
+class Stream:
+    def invalidate(self):
+        self._epoch += 1
+"""
+
+
+def test_epoch_bank_after_dispatch_caught():
+    src = EPOCH_CLASS + """
+    def refill(self):
+        draws = self.engine.decision_block(self._key)
+        self._ring.extend(draws)
+"""
+    f = run(src, ["epoch"])
+    assert "bank-after-dispatch" in rules(f)
+    clean = src.replace("draws = self.engine.decision_block(self._key)",
+                        "snap = self._epoch\n"
+                        "        draws = self.engine"
+                        ".decision_block(self._key)\n"
+                        "        if snap != self._epoch:\n"
+                        "            return")
+    assert run(clean, ["epoch"]) == []
+
+
+def test_epoch_swap_without_invalidate_caught():
+    src = EPOCH_CLASS + """
+    def rebind(self):
+        self._hot_dev = self.engine.put_replicated(self._hot_host)
+"""
+    f = run(src, ["epoch"])
+    assert "swap-without-invalidate" in rules(f)
+    clean = src.replace("self._hot_dev = self.engine"
+                        ".put_replicated(self._hot_host)",
+                        "self._hot_dev = self.engine"
+                        ".put_replicated(self._hot_host)\n"
+                        "        self.invalidate()")
+    assert run(clean, ["epoch"]) == []
+
+
+def test_epoch_resolve_reads_live_table_caught():
+    src = """
+class Signal:
+    def snapshot(self):
+        return dict(self._frontier)
+
+    def resolve_slab(self, ticket):
+        return self._frontier[ticket.row]
+"""
+    f = run(src, ["epoch"])
+    assert "resolve-reads-live-table" in rules(f)
+    clean = src.replace("return self._frontier[ticket.row]",
+                        "return ticket.frontier[ticket.row]")
+    assert run(clean, ["epoch"]) == []
+
+
+def test_lifetime_passes_real_tree_clean():
+    """The tentpole acceptance bar: all three buffer-lifetime passes
+    run clean over the real tree (the production idioms — donated
+    carry, copy-at-handoff, epoch-dated feeds — hold everywhere)."""
+    rep = vet.run_repo()
+    lifetime = [f for f in rep.findings
+                if f.pass_name in ("donation", "aliasing", "epoch")
+                and not f.baselined]
+    assert not lifetime, "\n".join(f.render() for f in lifetime)
+
+
 # -- the gate itself --------------------------------------------------------
 
 
@@ -909,6 +1155,15 @@ def test_vet_self_clean():
     assert not rep.parse_errors, rep.parse_errors
     assert not rep.p0_unbaselined, "\n".join(
         f.render() for f in rep.p0_unbaselined)
+
+
+def test_vet_ratchet_self_clean():
+    """The P1 ratchet: zero unbaselined P1s on the real tree.  A new
+    P1 must be fixed or get a justified baseline entry — the count
+    only goes down."""
+    rep = vet.run_repo()
+    assert not rep.p1_unbaselined, "\n".join(
+        f.render() for f in rep.p1_unbaselined)
 
 
 def test_vet_cli_json(capsys):
@@ -924,7 +1179,134 @@ def test_vet_cli_json(capsys):
     assert rep["counts"]["p0_unbaselined"] == 0
     assert set(rep["counts"]["by_pass"]) <= {
         "lock", "purity", "retrace", "schema", "stats", "hotpath",
-        "kernel-parity"}
+        "kernel-parity", "donation", "aliasing", "epoch"}
+    # schema stability: these keys are the CI artifact contract
+    assert set(rep) == {"counts", "findings", "parse_errors",
+                        "stale_baseline", "ok"}
+    assert {"total", "p0", "p1", "p0_unbaselined", "p1_unbaselined",
+            "baselined", "by_pass"} <= set(rep["counts"])
+    for fd in rep["findings"][:3]:
+        assert {"pass", "rule", "severity", "path", "line", "scope",
+                "message", "hint", "ident", "baselined"} == set(fd)
+
+
+# -- CLI surface: exit codes, ratchet, baselines -----------------------------
+
+
+P0_FIXTURE = """
+import threading, time
+_mu = threading.Lock()
+
+def capture(seconds):
+    with _mu:
+        time.sleep(seconds)
+"""
+
+P1_FIXTURE = """
+import numpy as np
+import jax.numpy as jnp
+
+class Signal:
+    def submit(self):
+        win = np.zeros((8, 32), np.uint32)
+        self._dev = jnp.asarray(win)
+        win[0, 0] = 1
+        return self._dev
+"""
+
+CLEAN_FIXTURE = """
+def add(a, b):
+    return a + b
+"""
+
+
+def _cli(tmp_path, src, *flags, baseline=""):
+    """Run the vet CLI over one fixture with an isolated baseline."""
+    from syzkaller_tpu.vet.__main__ import main
+
+    target = tmp_path / "fixture.py"
+    target.write_text(textwrap.dedent(src))
+    bl = tmp_path / "baseline.txt"
+    bl.write_text(baseline)
+    return main([str(target), "--baseline", str(bl), *flags]), target, bl
+
+
+def test_cli_exit_p0_blocks(tmp_path, capsys):
+    rc, _, _ = _cli(tmp_path, P0_FIXTURE)
+    assert rc == 1
+    assert "blocking-under-lock" in capsys.readouterr().out
+
+
+def test_cli_exit_p1_warns_without_ratchet(tmp_path, capsys):
+    rc, _, _ = _cli(tmp_path, P1_FIXTURE)
+    out = capsys.readouterr().out
+    assert rc == 0                      # P1s never block the base gate
+    assert "1 unbaselined P1" in out
+
+
+def test_cli_exit_p1_blocks_under_ratchet(tmp_path, capsys):
+    rc, _, _ = _cli(tmp_path, P1_FIXTURE, "--ratchet")
+    out = capsys.readouterr().out
+    assert rc == 1
+    # ratchet implies verbose: the P1 itself is printed, not just counted
+    assert "mutate-after-handoff" in out
+
+
+def test_cli_exit_clean(tmp_path, capsys):
+    rc, _, _ = _cli(tmp_path, CLEAN_FIXTURE, "--ratchet")
+    assert rc == 0
+    assert "0 finding(s)" in capsys.readouterr().out
+
+
+def test_cli_baselined_p1_passes_ratchet(tmp_path, capsys):
+    rc, target, _ = _cli(tmp_path, P1_FIXTURE, "--ratchet",
+                         baseline="")
+    assert rc == 1
+    # take the ident from the JSON report and justify it
+    import json
+
+    from syzkaller_tpu.vet.__main__ import main
+
+    capsys.readouterr()
+    main([str(target), "--json", "--baseline",
+          str(tmp_path / "empty.txt")])
+    rep = json.loads(capsys.readouterr().out)
+    (ident,) = [f["ident"] for f in rep["findings"]]
+    rc, _, _ = _cli(tmp_path, P1_FIXTURE, "--ratchet",
+                    baseline=f"{ident}  # ring is drained before reuse\n")
+    assert rc == 0
+
+
+def test_cli_write_baseline_roundtrip(tmp_path, capsys):
+    """--write-baseline appends P1 idents under ratchet; the written
+    entries carry the justification placeholder, load back, and
+    suppress the finding on the next run (the add path); removing the
+    finding then reports the entry as stale (the expire path)."""
+    out_bl = tmp_path / "new-baseline.txt"
+    rc, target, _ = _cli(tmp_path, P1_FIXTURE, "--ratchet",
+                         "--write-baseline", str(out_bl))
+    assert rc == 1                      # writing does not green the run
+    text = out_bl.read_text()
+    assert "mutate-after-handoff" in text and "# TODO: justify" in text
+    from syzkaller_tpu.vet.__main__ import main
+
+    capsys.readouterr()
+    rc = main([str(target), "--ratchet", "--baseline", str(out_bl)])
+    assert rc == 0                      # round-trip: entry suppresses
+    target.write_text(textwrap.dedent(CLEAN_FIXTURE))
+    rc = main([str(target), "--ratchet", "--baseline", str(out_bl)])
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "stale baseline entry" in out
+
+
+def test_cli_p0_not_maskable_by_ratchet_baseline(tmp_path, capsys):
+    # a baselined P0 passes; an unbaselined P0 fails even when every
+    # P1 is baselined — the ratchet never loosens the P0 gate
+    rc, target, _ = _cli(tmp_path, P0_FIXTURE + P1_FIXTURE, "--ratchet")
+    assert rc == 1
+    out = capsys.readouterr().out
+    assert "blocking-under-lock" in out
 
 
 def test_parse_error_blocks_gate(tmp_path):

@@ -1,13 +1,17 @@
 #!/usr/bin/env python3
 """CI driver: machine-readable static-analysis gate.
 
-Runs `python -m syzkaller_tpu.vet --json`, surfaces per-pass finding
-counts in a short human summary (and the raw JSON with --raw), and
-exits with vet's status — unbaselined P0s or parse errors fail the job.
-With --full it then runs the whole presubmit gate (which re-runs vet as
-its first analysis step, plus build/tests/smokes).
+Runs `python -m syzkaller_tpu.vet --json --ratchet`, surfaces per-pass
+finding counts in a short human summary (and the raw JSON with --raw),
+and exits with vet's status — unbaselined P0s, unbaselined P1s (the
+ratchet), or parse errors fail the job.  Both planes of the lifetime
+sanitizer leave build artifacts in --artifacts: the vet JSON report
+(static plane) and the syz-san summary from an armed smoke run
+(runtime plane).  With --full it then runs the whole presubmit gate
+(which re-runs vet as its first analysis step, plus
+build/tests/smokes).
 
-    python tools/ci.py [--raw] [--full]
+    python tools/ci.py [--raw] [--full] [--artifacts DIR]
 """
 
 from __future__ import annotations
@@ -23,12 +27,48 @@ ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 def run_vet() -> tuple[int, dict]:
     r = subprocess.run(
-        [sys.executable, "-m", "syzkaller_tpu.vet", "--json"],
+        [sys.executable, "-m", "syzkaller_tpu.vet", "--json", "--ratchet"],
         cwd=ROOT, capture_output=True, text=True)
     if not r.stdout.strip():
         sys.stderr.write(r.stderr)
         raise SystemExit(f"vet produced no JSON (rc={r.returncode})")
     return r.returncode, json.loads(r.stdout)
+
+
+# a tiny armed engine exercise in a subprocess: the published summary
+# is a REAL clean run of the runtime plane (shadow checker + lockset
+# audit live over actual dispatches), not just {"armed": false}
+_SAN_SUMMARY = r"""
+import json, os
+os.environ["SYZ_SAN"] = "1"
+import numpy as np
+from syzkaller_tpu import san
+from syzkaller_tpu.cover.engine import CoverageEngine
+
+eng = CoverageEngine(npcs=1 << 10, ncalls=8, corpus_cap=64,
+                     batch=4, max_pcs_per_exec=16)
+rng = np.random.default_rng(3)
+for _ in range(4):
+    idx = rng.integers(0, 1 << 10, (4, 16)).astype(np.int32)
+    valid = np.ones((4, 16), bool)
+    cids = rng.integers(0, 8, (4,)).astype(np.int32)
+    res = eng.update_batch(cids, idx, valid)
+    rows = np.nonzero(res.has_new)[0]
+    if len(rows):
+        eng.admit_rows(res, cids, rows)
+print(json.dumps(san.summary(), sort_keys=True))
+"""
+
+
+def run_san_summary() -> dict:
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    r = subprocess.run([sys.executable, "-c", _SAN_SUMMARY],
+                       cwd=ROOT, capture_output=True, text=True, env=env)
+    if r.returncode != 0 or not r.stdout.strip():
+        sys.stderr.write(r.stderr[-2000:])
+        raise SystemExit(f"san summary smoke failed (rc={r.returncode})")
+    return json.loads(r.stdout.strip().splitlines()[-1])
 
 
 def main(argv=None) -> int:
@@ -37,9 +77,26 @@ def main(argv=None) -> int:
                     help="also print vet's raw JSON report")
     ap.add_argument("--full", action="store_true",
                     help="run the full presubmit gate after vet")
+    ap.add_argument("--artifacts", default=os.path.join(ROOT, "ci-artifacts"),
+                    metavar="DIR",
+                    help="where to write vet-report.json and "
+                         "san-summary.json (default: <repo>/ci-artifacts)")
     args = ap.parse_args(argv)
 
     rc, rep = run_vet()
+    os.makedirs(args.artifacts, exist_ok=True)
+    with open(os.path.join(args.artifacts, "vet-report.json"), "w",
+              encoding="utf-8") as f:
+        json.dump(rep, f, indent=2, sort_keys=True)
+    san_sum = run_san_summary()
+    with open(os.path.join(args.artifacts, "san-summary.json"), "w",
+              encoding="utf-8") as f:
+        json.dump(san_sum, f, indent=2, sort_keys=True)
+    print(f"[ci] san: armed={san_sum['armed']} "
+          f"findings={san_sum['total']} (artifact san-summary.json)")
+    if san_sum["total"] != 0:
+        print("[ci] FAIL: runtime sanitizer found lifetime violations")
+        return 1
     c = rep["counts"]
     print(f"[ci] vet: {c['total']} finding(s) — "
           f"{c['p0']} P0 ({c['p0_unbaselined']} unbaselined), "
@@ -53,7 +110,7 @@ def main(argv=None) -> int:
     if args.raw:
         print(json.dumps(rep, indent=2, sort_keys=True))
     if rc != 0:
-        print("[ci] FAIL: vet gate (unbaselined P0s or parse errors)")
+        print("[ci] FAIL: vet gate (unbaselined P0s/P1s or parse errors)")
         return rc
 
     if args.full:
