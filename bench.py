@@ -1270,6 +1270,164 @@ def bench_tsdb(smoke=False):
             "tsdb_recompiles_warm": int(cc.count)}
 
 
+def bench_corpus_tiers(smoke=False):
+    """Tiered corpus hierarchy: a corpus ≥100x the fixed device cap
+    fuzzed through the hot tables with eviction-kernel demotion to the
+    warm mmap'd segment log and contents-only promotion back.  Reports
+
+      * `tier_hot_hit_rate`      — resolve-path hot-tier hits over a
+                                   recency-skewed working set (the
+                                   presubmit gates ≥ 0.9);
+      * `tier_recompiles_warm`   — CompileCounter over the ENTIRE
+                                   over-cap + promote phase (gated 0:
+                                   warm traffic is contents-only swaps
+                                   behind fixed dispatch signatures);
+      * `tier_promotions_per_sec`— warm→hot promotion throughput
+                                   (read_rows mmap gather + one swap
+                                   dispatch per batch);
+      * `tier_dispatch_constancy`— late/early mean admission-batch
+                                   wall time; ~1.0 means dispatch cost
+                                   does not grow with warm-tier size;
+      * `tier_frontier_bit_exact`— fused tiered fuzz ticks vs an
+                                   unbounded-table oracle on a subset
+                                   stream: identical admission verdicts
+                                   and max/corpus-cover frontiers.
+    """
+    import tempfile
+
+    from syzkaller_tpu.corpus import TierManager, WarmStore
+    from syzkaller_tpu.cover.engine import CoverageEngine
+    from syzkaller_tpu.fuzzer.pcmap import DeviceKeyMirror, PcMap
+    from syzkaller_tpu.vet.runtime import CompileCounter
+
+    rng = np.random.default_rng(29)
+    cap = 64 if smoke else 1024
+    W = 16                                 # signal words per row
+    nbatch = 32 if smoke else 256
+    total = 100 * cap                      # ≥100x the device cap
+    tmp = tempfile.mkdtemp(prefix="syz-tier-bench-")
+
+    eng = CoverageEngine(npcs=W * 32, ncalls=8, corpus_cap=cap,
+                         batch=nbatch, max_pcs_per_exec=8)
+    tm = TierManager(WarmStore(os.path.join(tmp, "warm")), engine=eng)
+
+    def batch_bitmaps(n):
+        bm = np.zeros((n, eng.W), np.uint32)
+        bm[:, :W] = rng.integers(1, 2 ** 32, (n, W), dtype=np.uint32)
+        return bm
+
+    # phase 1 — grow a 100x-cap corpus through the admission path.
+    # Warmup batches outside the counter compile every admission
+    # signature (under-cap append, partial-over, full-over demote);
+    # everything after is gated zero-recompile.  Batch wall times feed
+    # the constancy ratio.
+    def grow():
+        nonlocal owner
+        rows = eng.merge_corpus(rng.integers(0, 8, nbatch)
+                                .astype(np.int64), batch_bitmaps(nbatch))
+        tm.set_owners(rows, np.arange(owner, owner + nbatch))
+        owner += nbatch
+
+    owner = 0
+    nwarm = cap // nbatch + 2
+    for _ in range(nwarm):
+        grow()
+    nsteps = total // nbatch - nwarm
+    times = np.zeros(nsteps)
+
+    # warm the promote path too: both pow2 swap buckets the probe
+    # batches can hit (1..8 -> 8, 9..16 -> 16 warm misses), against
+    # ids that really are warm-resident right now
+    warm_ids = np.setdiff1d(np.arange(owner),
+                            tm.row_owner[tm.row_owner >= 0])
+    assert (tm.resolve_rows(warm_ids[:1]) >= 0).all()
+    assert (tm.resolve_rows(warm_ids[1:13]) >= 0).all()
+    base_promos = tm.stat_promotions
+    base_hits, base_misses = tm.stat_hot_hits, tm.stat_hot_misses
+
+    with CompileCounter() as cc:
+        for i in range(nsteps):
+            t0 = time.perf_counter()
+            grow()
+            times[i] = time.perf_counter() - t0
+
+        # phase 2 — recency-skewed resolve traffic: ~95% of each probe
+        # batch targets owners currently hot (the most recently
+        # admitted/promoted), the rest reach back into the warm log;
+        # every warm miss promotes through the fixed-shape swap
+        nprobe = 40 if smoke else 200
+        probe_b = 16
+        t0 = time.perf_counter()
+        for _ in range(nprobe):
+            hot_now = tm.row_owner[tm.row_owner >= 0]
+            recent = rng.choice(hot_now, probe_b - 1)
+            deep = rng.integers(0, owner - cap, 1)
+            got = tm.resolve_rows(np.concatenate([recent, deep]))
+            assert (got >= 0).all()
+        probe_dt = time.perf_counter() - t0
+    hits = tm.stat_hot_hits - base_hits
+    misses = tm.stat_hot_misses - base_misses
+    hit_rate = hits / max(1, hits + misses)
+
+    # phase 3 — frontier bit-exactness: fused tiered ticks vs an
+    # unbounded-table oracle over the same exec stream
+    n_execs = 1000 if smoke else 10_000
+    B, K = 8, 16
+
+    def mk(c):
+        e = CoverageEngine(npcs=1 << 12, ncalls=8, corpus_cap=c,
+                           batch=B, max_pcs_per_exec=K)
+        m = DeviceKeyMirror(PcMap(1 << 12), put=e.put_replicated)
+        return e, m
+
+    tiered, mir_t = mk(32)
+    TierManager(WarmStore(os.path.join(tmp, "warm2")), engine=tiered)
+    oracle, mir_o = mk(1 << 14)
+    bit_exact = True
+    srng = np.random.default_rng(31)
+    for it in range(n_execs // B):
+        if it % 4 == 0:                    # fresh signal batch
+            win = (np.arange(K, dtype=np.uint32)[None, :]
+                   + np.arange(B, dtype=np.uint32)[:, None] * K
+                   + it * B * K + 1)
+        else:                              # duplicate churn
+            win = (np.arange(K, dtype=np.uint32)[None, :]
+                   + np.arange(B, dtype=np.uint32)[:, None] * K
+                   + (it - it % 4) * B * K + 1)
+        win = win.astype(np.uint32)
+        counts = np.full((B,), K, np.int32)
+        cids = srng.integers(0, 8, B).astype(np.int32)
+        prev = np.full((4,), -1, np.int32)
+        live = np.arange(K)[None, :] < counts[:, None]
+        mir_t.ensure(win[live])
+        mir_o.ensure(win[live])
+        rt = tiered.fuzz_tick(win, counts, cids, prev, mir_t)
+        ro = oracle.fuzz_tick(win, counts, cids, prev, mir_o)
+        if not np.array_equal(rt.has_new, ro.has_new):
+            bit_exact = False
+    bit_exact = (bit_exact
+                 and np.array_equal(np.asarray(tiered.max_cover),
+                                    np.asarray(oracle.max_cover))
+                 and np.array_equal(np.asarray(tiered.corpus_cover),
+                                    np.asarray(oracle.corpus_cover)))
+
+    tenth = max(1, nsteps // 10)
+    constancy = float(np.mean(times[-tenth:]) / np.mean(times[:tenth]))
+    return {
+        "tier_corpus_records": owner,
+        "tier_corpus_cap": cap,
+        "tier_rows_warm": int(tm.store.rows_warm),
+        "tier_bytes_warm": int(tm.store.bytes_warm),
+        "tier_hot_hit_rate": round(hit_rate, 4),
+        "tier_promotions_per_sec": round((tm.stat_promotions
+                                          - base_promos)
+                                         / max(probe_dt, 1e-9), 1),
+        "tier_recompiles_warm": int(cc.count),
+        "tier_dispatch_constancy": round(constancy, 3),
+        "tier_frontier_bit_exact": bool(bit_exact),
+    }
+
+
 def bench_fuzz_tick(smoke=False):
     """Single-dispatch fuzz tick: engine.fuzz_tick fuses
     ingest-translate → signal-diff → admission gate/merge → tsdb bump →
@@ -1559,6 +1717,8 @@ def main(argv=None):
     extras.update(bench_autopilot(smoke=args.smoke))
     _stage("fleet observatory (tsdb rollup)")
     extras.update(bench_tsdb(smoke=args.smoke))
+    _stage("tiered corpus hierarchy")
+    extras.update(bench_corpus_tiers(smoke=args.smoke))
     # static-analysis gate trajectory: the BENCH_*.json series records
     # the vet finding counts alongside throughput, so a PR that buys
     # speed by parking P0s in the baseline shows up in the history

@@ -1,0 +1,31 @@
+"""Tiered corpus hierarchy: hot device tables / warm mmap'd segment
+log / cold persistent corpus.  See segments.py for the wire format and
+tiers.py for the promotion/eviction contract."""
+
+from syzkaller_tpu.corpus.segments import (
+    MAGIC,
+    MAX_SEGMENTS,
+    MIN_STRIDE,
+    REC_COMMIT,
+    UNOWNED,
+    VERSION,
+    SegmentError,
+    WarmStore,
+    decode_segment,
+    encode_segment,
+)
+from syzkaller_tpu.corpus.tiers import TierManager
+
+__all__ = [
+    "MAGIC",
+    "MAX_SEGMENTS",
+    "MIN_STRIDE",
+    "REC_COMMIT",
+    "UNOWNED",
+    "VERSION",
+    "SegmentError",
+    "WarmStore",
+    "TierManager",
+    "decode_segment",
+    "encode_segment",
+]

@@ -1,0 +1,468 @@
+"""Warm-tier corpus store: mmap'd slab-format append-log segments.
+
+The hot tier (the engine's fixed-cap device tables) is a cache; this
+store is its backing level.  Every row demoted from the device signal
+matrix lands here as one slab-format record and stays addressable by a
+monotonically increasing record id until compaction drops superseded
+generations.  The on-disk layout generalizes two existing formats:
+
+  * records reuse the PR-11 ring slab idiom (ipc/ring.py): fixed u32
+    words, a commit tag up front, explicit lengths, pow2-bucketed
+    strides — so a torn/garbage record is detectable from the record
+    alone;
+  * segments reuse the PR-9 `SYZSNAP1` envelope idiom
+    (resilience/checkpoint.py): magic + JSON header + payload sha256,
+    written crash-safe via tmp+rename (fileutil.write_file).  A
+    segment is immutable once renamed into place; crash recovery is
+    "load every segment that validates, newest compaction generation
+    wins" — no write-ahead log, no fsync ordering games.
+
+Segment wire format (little-endian):
+
+    offset  size  field
+    0       8     MAGIC  b"SYZWARM1"
+    8       4     u32 header length H
+    12      H     JSON header {"version": 2, "seq": int,
+                   "count": int, "stride": int (u32 words/record),
+                   "sha256": hex(payload), "supersedes": [seq, ...],
+                   "meta": {...}}
+    12+H    4*count*stride   payload: count records of stride u32 words
+
+Record layout (stride u32 words, stride = pow2 bucket of the widest
+record in the segment; the signal row rides in COO — word indices +
+word values — because demoted rows are sparse by construction):
+
+    word 0            REC_COMMIT (0x53595A43 'SYZC')
+    word 1            record id (global, monotonically increasing)
+    word 2            call id
+    word 3            nnz (number of COO entries)
+    word 4            popcount of the signal row (promotion score hint)
+    word 5            admit tick (device recency at demotion)
+    word 6            owner (corpus item id; 0xFFFFFFFF = unowned)
+    word 7            reserved (0)
+    word 8..8+nnz     COO word indices (columns into the W-word row)
+    word 8+nnz..8+2nnz  COO word values
+    ...               zero padding to stride
+
+Reads are per-BATCH mmap gathers (np.memmap fancy indexing), never
+per-record Python loops: the only loop in the read path is the
+const-range sweep over the MAX_SEGMENTS segment slots (compaction
+keeps the live segment count at or under that bound), which the
+hotpath vet pass recognizes as constant-trip.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import threading
+
+import numpy as np
+
+from syzkaller_tpu.utils import fileutil
+from syzkaller_tpu.utils.shapes import pow2_bucket
+
+MAGIC = b"SYZWARM1"
+VERSION = 2                     # rides the snapshot codec's v2 bump
+REC_COMMIT = 0x53595A43         # 'SYZC'
+HDR_WORDS = 8
+MIN_STRIDE = 16
+# live segment bound: read_rows sweeps exactly this many segment slots
+# per batch (const-range — hotpath-vet clean) and maybe_compact folds
+# the log back to one segment before the bound is hit
+MAX_SEGMENTS = 16
+UNOWNED = 0xFFFFFFFF
+
+
+class SegmentError(Exception):
+    pass
+
+
+def encode_segment(seq: int, recs: np.ndarray, stride: int,
+                   supersedes: "list[int]", meta: "dict | None" = None
+                   ) -> bytes:
+    """(count, stride) u32 record block -> one segment blob."""
+    payload = np.ascontiguousarray(recs, dtype="<u4").tobytes()
+    header = {
+        "version": VERSION, "seq": int(seq), "count": int(recs.shape[0]),
+        "stride": int(stride), "sha256": hashlib.sha256(payload).hexdigest(),
+        "supersedes": [int(s) for s in supersedes], "meta": meta or {},
+    }
+    hb = json.dumps(header, sort_keys=True).encode()
+    return MAGIC + np.uint32(len(hb)).tobytes() + hb + payload
+
+
+def decode_segment(blob: bytes) -> tuple[dict, np.ndarray]:
+    """Validate one segment blob -> (header, (count, stride) u32).
+    Raises SegmentError on any corruption (magic, version, checksum,
+    truncation) — the loader skips-and-counts, never bricks."""
+    if len(blob) < len(MAGIC) + 4 or blob[:len(MAGIC)] != MAGIC:
+        raise SegmentError("bad segment magic")
+    hlen = int(np.frombuffer(blob[8:12], "<u4")[0])
+    if len(blob) < 12 + hlen:
+        raise SegmentError("truncated segment header")
+    try:
+        header = json.loads(blob[12:12 + hlen])
+    except ValueError as e:
+        raise SegmentError(f"bad segment header: {e}") from e
+    if header.get("version") != VERSION:
+        raise SegmentError(f"segment version {header.get('version')!r} "
+                           f"!= {VERSION}")
+    count, stride = int(header["count"]), int(header["stride"])
+    payload = blob[12 + hlen:]
+    if len(payload) != 4 * count * stride:
+        raise SegmentError("segment payload length mismatch")
+    if hashlib.sha256(payload).hexdigest() != header["sha256"]:
+        raise SegmentError("segment checksum mismatch")
+    recs = np.frombuffer(payload, "<u4").reshape(count, stride)
+    if count and not (recs[:, 0] == REC_COMMIT).all():
+        raise SegmentError("uncommitted record in segment")
+    return header, recs
+
+
+def _seg_name(seq: int) -> str:
+    return f"seg-{seq:08d}.warm"
+
+
+class WarmStore:
+    """Append-log of demoted corpus rows with mmap'd batch reads.
+
+    Thread-safe.  Appends buffer in memory until `flush()` (or the
+    seg_records high-water mark) writes one immutable segment; readers
+    see a record only after its segment is durable, which is exactly
+    the crash contract the manager's persistence-before-resolve rule
+    needs (flush before acking a demotion batch externally)."""
+
+    def __init__(self, dirpath: str, seg_records: int = 8192,
+                 expect_refs: "list[dict] | None" = None):
+        self.dir = dirpath
+        self.seg_records = seg_records
+        self._mu = threading.RLock()
+        # pending (not yet durable) records, as (count, width) blocks
+        self._pending: list[np.ndarray] = []
+        self._pending_n = 0
+        # fixed segment slots (const-range read sweep): parallel lists
+        # padded to MAX_SEGMENTS with None/zeros
+        self._maps: list["np.memmap | None"] = [None] * MAX_SEGMENTS
+        self._seqs = [0] * MAX_SEGMENTS
+        self._nseg = 0
+        # record directory: id -> (segment slot, row) — grown
+        # geometrically, -1 = unknown id
+        self._dir_seg = np.full(1024, -1, np.int32)
+        self._dir_row = np.zeros(1024, np.int32)
+        self.next_id = 0
+        self.next_seq = 1
+        self.corrupt_skipped = 0        # segments skipped on load
+        self.ref_mismatches = 0         # snapshot refs that didn't check out
+        self.bytes_warm = 0
+        self.stat_flushes = 0
+        self.stat_compactions = 0
+        self._fault = None              # test hook: called at compaction stages
+        os.makedirs(dirpath, exist_ok=True)
+        self._load(expect_refs)
+
+    # -- load / recovery -------------------------------------------------
+
+    def _load(self, expect_refs: "list[dict] | None") -> None:
+        names = sorted(n for n in os.listdir(self.dir)
+                       if n.startswith("seg-") and n.endswith(".warm"))
+        loaded: dict[int, tuple[str, dict]] = {}
+        for name in names:
+            path = os.path.join(self.dir, name)
+            try:
+                with open(path, "rb") as f:
+                    header, _recs = decode_segment(f.read())
+                loaded[int(header["seq"])] = (name, header)
+            except (OSError, SegmentError):
+                self.corrupt_skipped += 1
+        # newest valid compaction generation wins: a validated segment
+        # shadows every seq it supersedes; a corrupt compacted segment
+        # simply never shadows, so its sources restore (zero loss)
+        dead: set[int] = set()
+        for seq in sorted(loaded, reverse=True):
+            if seq in dead:
+                continue
+            dead.update(int(s) for s in loaded[seq][1]["supersedes"])
+        live = [s for s in sorted(loaded) if s not in dead]
+        if expect_refs is not None:
+            have = {loaded[s][1]["sha256"] for s in live}
+            self.ref_mismatches += sum(
+                1 for r in expect_refs if r.get("sha256") not in have)
+        for seq in live:
+            name, header = loaded[seq]
+            self._mount(os.path.join(self.dir, name), header)
+        if loaded:
+            self.next_seq = max(loaded) + 1
+
+    def _mount(self, path: str, header: dict) -> None:
+        count, stride = int(header["count"]), int(header["stride"])
+        hlen = len(json.dumps(header, sort_keys=True).encode())
+        mm = np.memmap(path, dtype="<u4", mode="r", offset=12 + hlen,
+                       shape=(count, stride))
+        slot = self._nseg
+        if slot >= MAX_SEGMENTS:
+            raise SegmentError("warm store segment slots exhausted "
+                               "(compaction required)")
+        self._maps[slot] = mm
+        self._seqs[slot] = int(header["seq"])
+        self._nseg += 1
+        ids = np.asarray(mm[:, 1], np.int64)
+        self._index(ids, slot, np.arange(count, dtype=np.int32))
+        if count:
+            self.next_id = max(self.next_id, int(ids.max()) + 1)
+        self.bytes_warm += int(mm.nbytes)
+
+    def _index(self, ids: np.ndarray, slot: int, rows: np.ndarray) -> None:
+        if len(ids) == 0:
+            return
+        top = int(ids.max())
+        if top >= len(self._dir_seg):
+            n = len(self._dir_seg)
+            while n <= top:
+                n *= 2
+            seg = np.full(n, -1, np.int32)
+            row = np.zeros(n, np.int32)
+            seg[:len(self._dir_seg)] = self._dir_seg
+            row[:len(self._dir_row)] = self._dir_row
+            self._dir_seg, self._dir_row = seg, row
+        self._dir_seg[ids] = slot
+        self._dir_row[ids] = rows
+
+    # -- append (demotion) -----------------------------------------------
+
+    def append_rows(self, call_ids, rows, admit_ticks, owners) -> np.ndarray:
+        """Buffer a batch of demoted rows ((n, W) u32 bitmaps) as COO
+        records; returns the assigned record ids.  Fully vectorized —
+        the COO split is one np.nonzero over the whole batch."""
+        rows = np.asarray(rows, np.uint32)
+        n, _W = rows.shape
+        if n == 0:
+            return np.zeros((0,), np.int64)
+        call_ids = np.asarray(call_ids, np.int64)
+        admit_ticks = np.asarray(admit_ticks, np.int64)
+        owners = np.asarray(owners, np.int64)
+        r, c = np.nonzero(rows)
+        nnz = np.bincount(r, minlength=n).astype(np.int64)
+        width = HDR_WORDS + 2 * int(nnz.max(initial=0))
+        pop = _popcount_rows_np(rows)
+        with self._mu:
+            ids = np.arange(self.next_id, self.next_id + n, dtype=np.int64)
+            self.next_id += n
+            block = np.zeros((n, width), np.uint32)
+            block[:, 0] = REC_COMMIT
+            block[:, 1] = ids.astype(np.uint32)
+            block[:, 2] = call_ids.astype(np.uint32)
+            block[:, 3] = nnz.astype(np.uint32)
+            block[:, 4] = pop.astype(np.uint32)
+            block[:, 5] = admit_ticks.astype(np.uint32)
+            block[:, 6] = np.where(owners < 0, UNOWNED,
+                                   owners).astype(np.uint32)
+            start = np.concatenate([[0], np.cumsum(nnz)[:-1]])
+            pos = np.arange(len(r)) - start[r]
+            block[r, HDR_WORDS + pos] = c.astype(np.uint32)
+            block[r, HDR_WORDS + nnz[r] + pos] = rows[r, c]
+            self._pending.append(block)
+            self._pending_n += n
+            if self._pending_n >= self.seg_records:
+                self._flush_locked()
+        return ids
+
+    def flush(self) -> None:
+        """Make every buffered record durable (one tmp+rename segment)."""
+        with self._mu:
+            self._flush_locked()
+
+    def _flush_locked(self) -> None:
+        if not self._pending:
+            return
+        width = max(b.shape[1] for b in self._pending)
+        stride = pow2_bucket(width, MIN_STRIDE, 1 << 16)
+        recs = np.zeros((self._pending_n, stride), np.uint32)
+        at = 0
+        for b in self._pending:
+            recs[at:at + b.shape[0], :b.shape[1]] = b
+            at += b.shape[0]
+        self._pending, self._pending_n = [], 0
+        seq = self.next_seq
+        self.next_seq += 1
+        blob = encode_segment(seq, recs, stride, supersedes=[])
+        path = os.path.join(self.dir, _seg_name(seq))
+        fileutil.write_file(path, blob)
+        header, _ = decode_segment(blob)
+        self._mount(path, header)
+        self.stat_flushes += 1
+        self.maybe_compact()
+
+    # -- read (resolve / promotion) --------------------------------------
+
+    def read_rows(self, ids, W: int):
+        """Per-BATCH mmap gather: record ids -> (call_ids (n,),
+        bitmaps (n, W) u32, popcounts (n,), admit_ticks (n,),
+        owners (n,)).  Unknown ids raise KeyError.  The only loop is
+        the const-range sweep over the MAX_SEGMENTS segment slots."""
+        ids = np.asarray(ids, np.int64)
+        n = len(ids)
+        call_ids = np.zeros((n,), np.int64)
+        bitmaps = np.zeros((n, W), np.uint32)
+        pops = np.zeros((n,), np.int64)
+        ticks = np.zeros((n,), np.int64)
+        owners = np.full((n,), -1, np.int64)
+        with self._mu:
+            if n == 0:
+                return call_ids, bitmaps, pops, ticks, owners
+            if int(ids.min()) < 0 or int(ids.max()) >= self.next_id:
+                raise KeyError("unknown warm record id")
+            if self._pending_n and int(ids.max()) >= \
+                    self.next_id - self._pending_n:
+                # a requested record is still buffered: make the batch
+                # durable first so ONE mmap path serves every read
+                self._flush_locked()
+            if int(ids.max()) >= len(self._dir_seg):
+                raise KeyError("unknown warm record id")
+            seg = self._dir_seg[ids]
+            row = self._dir_row[ids]
+            if (seg < 0).any():
+                raise KeyError("unknown warm record id")
+            for slot in range(MAX_SEGMENTS):
+                mm = self._maps[slot]
+                here = seg == slot
+                if mm is None or not here.any():
+                    continue
+                recs = np.asarray(mm[row[here]])       # ONE mmap gather
+                call_ids[here] = recs[:, 2]
+                pops[here] = recs[:, 4]
+                ticks[here] = recs[:, 5]
+                own = recs[:, 6].astype(np.int64)
+                owners[here] = np.where(own == UNOWNED, -1, own)
+                nnz = recs[:, 3].astype(np.int64)
+                K = (recs.shape[1] - HDR_WORDS) // 2
+                k = np.arange(K)
+                valid = k[None, :] < nnz[:, None]
+                cols = recs[:, HDR_WORDS:HDR_WORDS + K]
+                base = HDR_WORDS + nnz[:, None] + k[None, :]
+                vals = np.take_along_axis(recs, np.minimum(
+                    base, recs.shape[1] - 1), axis=1)
+                dst = np.nonzero(here)[0]
+                rr = np.broadcast_to(dst[:, None], valid.shape)[valid]
+                cc = cols[valid].astype(np.int64)
+                ok = cc < W
+                bitmaps[rr[ok], cc[ok]] = vals[valid][ok]
+        return call_ids, bitmaps, pops, ticks, owners
+
+    def known(self, ids) -> np.ndarray:
+        """(n,) bool — which record ids are resolvable."""
+        ids = np.asarray(ids, np.int64)
+        with self._mu:
+            ok = (ids >= 0) & (ids < len(self._dir_seg))
+            out = np.zeros(len(ids), bool)
+            out[ok] = self._dir_seg[ids[ok]] >= 0
+            # buffered-but-not-yet-durable records are resolvable too
+            # (read_rows flushes on demand)
+            if self._pending_n:
+                out |= (ids >= self.next_id - self._pending_n) \
+                    & (ids < self.next_id)
+        return out
+
+    @property
+    def rows_warm(self) -> int:
+        with self._mu:
+            return int((self._dir_seg >= 0).sum()) + self._pending_n
+
+    # -- compaction ------------------------------------------------------
+
+    def maybe_compact(self) -> bool:
+        with self._mu:
+            if self._nseg < MAX_SEGMENTS - 1:
+                return False
+            self.compact()
+            return True
+
+    def compact(self) -> None:
+        """Fold every live segment into one: keep the newest record per
+        owner (a re-demoted row supersedes its older generation; id
+        order IS recency) plus every unowned record.  Crash-safe in
+        every window: the new segment lands via tmp+rename and lists
+        the seqs it supersedes, so a SIGKILL before the rename leaves
+        the old chain untouched, and one after it makes the old chain
+        shadowed-but-harmless until the unlinks finish."""
+        with self._mu:
+            self._flush_pending_for_compact()
+            slots = [s for s in range(MAX_SEGMENTS)
+                     if self._maps[s] is not None]
+            if not slots:
+                return
+            if self._fault is not None:
+                self._fault("pre-write")
+            blocks = [np.asarray(self._maps[s]) for s in slots]
+            stride = max(b.shape[1] for b in blocks)
+            recs = np.zeros((sum(b.shape[0] for b in blocks), stride),
+                            np.uint32)
+            at = 0
+            for b in blocks:
+                recs[at:at + b.shape[0], :b.shape[1]] = b
+                at += b.shape[0]
+            ids = recs[:, 1].astype(np.int64)
+            order = np.argsort(ids, kind="stable")
+            recs = recs[order]
+            own = recs[:, 6].astype(np.int64)
+            # newest record per owner: last occurrence in id order
+            last = np.zeros(len(recs), bool)
+            if len(recs):
+                uniq, first = np.unique(own[::-1], return_index=True)
+                keep_pos = len(recs) - 1 - first
+                last[keep_pos] = True
+                last[own == UNOWNED] = True
+            recs = recs[last]
+            seq = self.next_seq
+            self.next_seq += 1
+            supersedes = [self._seqs[s] for s in slots]
+            blob = encode_segment(seq, recs, stride, supersedes=supersedes)
+            path = os.path.join(self.dir, _seg_name(seq))
+            fileutil.write_file(path, blob)
+            if self._fault is not None:
+                self._fault("post-write")
+            for s in slots:
+                try:
+                    os.unlink(os.path.join(self.dir,
+                                           _seg_name(self._seqs[s])))
+                except OSError:
+                    pass
+                if self._fault is not None:
+                    self._fault("mid-unlink")
+            # remount from the compacted generation
+            self._maps = [None] * MAX_SEGMENTS
+            self._seqs = [0] * MAX_SEGMENTS
+            self._nseg = 0
+            self._dir_seg = np.full(len(self._dir_seg), -1, np.int32)
+            self.bytes_warm = 0
+            header, _ = decode_segment(blob)
+            self._mount(path, header)
+            self.stat_compactions += 1
+
+    def _flush_pending_for_compact(self) -> None:
+        if self._pending:
+            self._flush_locked()
+
+    # -- snapshot integration --------------------------------------------
+
+    def segment_refs(self) -> list[dict]:
+        """Durable-segment references for the v2 snapshot header —
+        refs, never inline blobs (the segments ARE the warm tier's
+        durability; the snapshot only has to name them)."""
+        with self._mu:
+            return [{"file": _seg_name(self._seqs[s]),
+                     "seq": int(self._seqs[s]),
+                     "count": int(self._maps[s].shape[0]),
+                     "sha256": hashlib.sha256(
+                         np.ascontiguousarray(self._maps[s]).tobytes()
+                     ).hexdigest()}
+                    for s in range(MAX_SEGMENTS)
+                    if self._maps[s] is not None]
+
+
+def _popcount_rows_np(rows: np.ndarray) -> np.ndarray:
+    """(n, W) u32 -> (n,) per-row set-bit counts."""
+    return np.unpackbits(
+        np.ascontiguousarray(rows).view(np.uint8),
+        axis=1).sum(axis=1, dtype=np.int64)

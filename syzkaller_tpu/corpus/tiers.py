@@ -1,0 +1,238 @@
+"""Three-tier corpus hierarchy: hot (device tables) / warm (mmap'd
+segment log) / cold (persistent disk/hub corpus).
+
+SURVEY §5 frames the device signal matrix as "a cache, rebuilt by
+replays"; this module makes that literal and continuous.  The hot tier
+keeps today's fixed-cap device tables and their zero-recompile dispatch
+shapes; when admission runs past `corpus_cap`, the fused fuzz tick's
+eviction-score kernel (kernels/oracles.py `evict_score` — per-row
+shadowed-signal count decayed by admit recency, the device analog of
+the reference's corpus minimization, manager.go:504-527) picks the
+victims IN the same dispatch, and the host swaps the evicted rows'
+contents out to the warm store.  Promotion is the reverse contents-only
+swap (the `DeviceKeyMirror` growth pattern): warm rows ride back into
+victim slots through a fixed-shape `swap_rows` dispatch, so warm-path
+traffic never changes a dispatch signature and never recompiles.
+
+The cold tier stays what it always was — the manager's persistent
+corpus / hub exchange; this module only needs to know it exists (a
+warm record's `owner` is the corpus item id both tiers key on).
+
+Host index kept here (flat numpy, so the resolve path is loop-free):
+  * row_owner (cap,)        — hot row -> corpus item id (-1 unowned)
+  * _loc_kind/_loc_val (N,) — corpus item id -> tier (HOT/WARM/absent)
+                              and its row / warm record id
+
+`resolve_rows` is the warm-tier resolve path the hotpath vet pass
+pins: one batched index lookup, at most ONE segment-store read and ONE
+swap dispatch per batch — never a per-item read.
+"""
+
+from __future__ import annotations
+
+import threading
+
+import numpy as np
+
+from syzkaller_tpu.corpus.segments import WarmStore
+
+ABSENT, HOT, WARM = -1, 0, 1
+
+
+class TierManager:
+    """Glue between a CoverageEngine's hot tables and a WarmStore.
+
+    Attach with `engine.attach_tiers(tm)`; from then on the engine's
+    fused fuzz tick demotes instead of falling back unfused, and
+    `merge_corpus` demotes instead of dropping.  All counters are plain
+    ints mirrored into the engine's DeviceStats slots when telemetry is
+    enabled (`syz_corpus_tier_*`)."""
+
+    def __init__(self, store: "WarmStore | str", engine=None,
+                 telemetry=None):
+        self.store = (store if isinstance(store, WarmStore)
+                      else WarmStore(store))
+        self.engine = None
+        self.tstats = telemetry
+        self._mu = threading.RLock()
+        self.row_owner: "np.ndarray | None" = None
+        self._loc_kind = np.full(1024, ABSENT, np.int8)
+        self._loc_val = np.zeros(1024, np.int64)
+        self.stat_evictions = 0
+        self.stat_promotions = 0
+        self.stat_hot_hits = 0
+        self.stat_hot_misses = 0
+        if engine is not None:
+            engine.attach_tiers(self)
+
+    # -- engine attach ----------------------------------------------------
+
+    def bind(self, engine) -> None:
+        """Called by CoverageEngine.attach_tiers."""
+        with self._mu:
+            self.engine = engine
+            if self.tstats is None:
+                self.tstats = engine.tstats
+            if self.row_owner is None or len(self.row_owner) != engine.cap:
+                self.row_owner = np.full((engine.cap,), -1, np.int64)
+
+    def _inc(self, key: str, n: int = 1) -> None:
+        ts = self.tstats
+        if ts is not None and n:
+            ts.inc(key, n)
+
+    # -- bookkeeping from the admission path ------------------------------
+
+    def _grow_loc(self, top: int) -> None:
+        if top < len(self._loc_kind):
+            return
+        n = len(self._loc_kind)
+        while n <= top:
+            n *= 2
+        kind = np.full(n, ABSENT, np.int8)
+        val = np.zeros(n, np.int64)
+        kind[:len(self._loc_kind)] = self._loc_kind
+        val[:len(self._loc_val)] = self._loc_val
+        self._loc_kind, self._loc_val = kind, val
+
+    def set_owners(self, rows, owners) -> None:
+        """Record which corpus item each hot row currently holds
+        (DeviceSignal calls this right after admission)."""
+        rows = np.asarray(rows, np.int64)
+        owners = np.asarray(owners, np.int64)
+        if len(rows) == 0:
+            return
+        with self._mu:
+            old = self.row_owner[rows]
+            self.row_owner[rows] = owners
+            stale = old[(old >= 0) & (old != owners)]
+            if len(stale):
+                self._loc_kind[stale] = ABSENT
+            known = owners >= 0
+            if known.any():
+                self._grow_loc(int(owners[known].max()))
+                self._loc_kind[owners[known]] = HOT
+                self._loc_val[owners[known]] = rows[known]
+
+    def on_evicted(self, victims, bitmaps, call_ids, admit_ticks) -> None:
+        """Engine callback: hot rows whose contents were just replaced
+        in-dispatch.  Their old contents append to the warm log; the
+        victims' slots now belong to the incoming inputs (the caller
+        follows up with set_owners)."""
+        victims = np.asarray(victims, np.int64)
+        n = len(victims)
+        if n == 0:
+            return
+        with self._mu:
+            owners = self.row_owner[victims]
+            ids = self.store.append_rows(call_ids, bitmaps, admit_ticks,
+                                         owners)
+            known = owners >= 0
+            if known.any():
+                self._grow_loc(int(owners[known].max()))
+                self._loc_kind[owners[known]] = WARM
+                self._loc_val[owners[known]] = ids[known]
+            self.row_owner[victims] = -1
+            self.stat_evictions += n
+        self._inc("tier_evictions", n)
+        self._inc("tier_warm_rows", n)
+
+    def on_compacted(self, mapping: dict) -> None:
+        """Engine compaction moved hot rows (old row -> new row);
+        unmapped rows were dropped — their owners fall out of the hot
+        index (back to cold: re-discoverable through the persistent
+        corpus, same as before tiers existed)."""
+        with self._mu:
+            if self.row_owner is None:
+                return
+            old = np.fromiter(mapping.keys(), np.int64, len(mapping))
+            new = np.fromiter(mapping.values(), np.int64, len(mapping))
+            owners = self.row_owner.copy()
+            self.row_owner[:] = -1
+            if len(old):
+                self.row_owner[new] = owners[old]
+            self._loc_kind[self._loc_kind == HOT] = ABSENT
+            surv = self.row_owner >= 0
+            o = self.row_owner[surv]
+            if len(o):
+                self._grow_loc(int(o.max()))
+                self._loc_kind[o] = HOT
+                self._loc_val[o] = np.nonzero(surv)[0]
+
+    # -- the warm-tier resolve path (hotpath-vet pinned) ------------------
+
+    def resolve_rows(self, owners) -> np.ndarray:
+        """Corpus item ids -> hot row indices, promoting warm-resident
+        items first.  Hot hits are an index lookup; misses cost ONE
+        batched segment-store read + ONE fixed-shape swap dispatch for
+        the whole batch (per-batch mmap reads only — never per-exec).
+        Items in neither tier come back -1 (cold: the caller replays
+        through the persistent corpus)."""
+        owners = np.asarray(owners, np.int64)
+        out = np.full(len(owners), -1, np.int64)
+        with self._mu:
+            inrange = (owners >= 0) & (owners < len(self._loc_kind))
+            kind = np.full(len(owners), ABSENT, np.int8)
+            kind[inrange] = self._loc_kind[owners[inrange]]
+            val = np.zeros(len(owners), np.int64)
+            val[inrange] = self._loc_val[owners[inrange]]
+            hot = kind == HOT
+            warm = kind == WARM
+            out[hot] = val[hot]
+            nhit = int(hot.sum())
+            nmiss = int(warm.sum())
+            self.stat_hot_hits += nhit
+            self.stat_hot_misses += nmiss
+            if nmiss:
+                out[warm] = self.promote(val[warm])
+        self._inc("tier_hot_hits", nhit)
+        self._inc("tier_hot_misses", nmiss)
+        return out
+
+    def promote(self, rec_ids) -> np.ndarray:
+        """Warm record ids -> hot rows.  Reads the records (one mmap
+        gather), swaps them into the lowest-retention hot rows through
+        the engine's fixed-shape swap dispatch (contents-only — zero
+        warm recompiles), and demotes the displaced rows' contents back
+        to the log.  Returns the hot rows now holding the records."""
+        rec_ids = np.asarray(rec_ids, np.int64)
+        n = len(rec_ids)
+        if n == 0:
+            return np.zeros((0,), np.int64)
+        eng = self.engine
+        with self._mu:
+            calls, bitmaps, _pops, _ticks, owners = self.store.read_rows(
+                rec_ids, eng.W)
+            scores = eng.evict_scores()
+            # victims: highest eviction score (most shadowed, oldest) —
+            # never a row we are about to install into in this batch
+            victims = np.argsort(scores, kind="stable")[::-1][:n]
+            victims = victims.astype(np.int64)
+            old_calls = eng.corpus_call[victims].copy()
+            old_rows = eng.swap_rows(victims, bitmaps, calls)
+            self.on_evicted(victims, old_rows, old_calls,
+                            np.full((n,), eng.tick, np.int64))
+            self.set_owners(victims, owners)
+            self.stat_promotions += n
+        self._inc("tier_promotions", n)
+        return victims
+
+    # -- snapshot integration ---------------------------------------------
+
+    def flush(self) -> None:
+        self.store.flush()
+
+    def segment_refs(self) -> list[dict]:
+        self.store.flush()
+        return self.store.segment_refs()
+
+    def snapshot_counters(self) -> dict:
+        return {
+            "rows_warm": self.store.rows_warm,
+            "bytes_warm": self.store.bytes_warm,
+            "evictions": self.stat_evictions,
+            "promotions": self.stat_promotions,
+            "hot_hits": self.stat_hot_hits,
+            "hot_misses": self.stat_hot_misses,
+            "segments_corrupt_skipped": self.store.corrupt_skipped,
+        }

@@ -370,6 +370,7 @@ def sparse_update(max_cover: jax.Array, call_ids: jax.Array,
 # for the long-standing import sites); the engine resolves the plane-
 # selected implementation through kernels.KERNELS at _build() time.
 from syzkaller_tpu.kernels import KERNELS  # noqa: E402
+from syzkaller_tpu.utils.shapes import pow2_bucket  # noqa: E402
 from syzkaller_tpu.kernels.oracles import (popcount_rows,  # noqa: E402,F401
                                            signal_diff, synth_gather,
                                            translate_slab_rows)
@@ -768,6 +769,7 @@ class FuzzTickResult:
     new_bits: np.ndarray         # (B,) per-input new-bit counts
     miss_rows: jax.Array         # (B,) bool device — first-sight rows
     fused: bool = True           # False when the cap fallback ran unfused
+    n_evicted: int = 0           # hot rows demoted warm (tiered only)
 
     def signal_view(self) -> "IngestResult":
         """The signal-plane slice as an IngestResult — what
@@ -855,6 +857,17 @@ class CoverageEngine:
         self.corpus_mat = jnp.zeros((corpus_cap, self.W), jnp.uint32)
         self.corpus_call = np.zeros((corpus_cap,), np.int32)  # host-read only
         self.corpus_len = 0
+        # per-row last-admit tick: the recency input of the eviction
+        # score.  Only the fused tick and swap_rows maintain it (other
+        # admit paths leave 0 = maximally old) — a row that never rode
+        # the tiered paths is simply first in line to demote.
+        self.corpus_seen = jnp.zeros((corpus_cap,), jnp.int32)
+        self._tick = 0
+        # tiered corpus hierarchy (corpus/tiers.py TierManager) — when
+        # attached, admission past corpus_cap demotes the
+        # lowest-retention rows to the warm store instead of falling
+        # back unfused/dropping
+        self.tiers = None
         self.prios = jnp.full((ncalls, ncalls), 1.0, jnp.float32)
         self.enabled = jnp.ones((ncalls,), jnp.bool_)
         # dummy stat-vector operands for the telemetry-disabled mode:
@@ -888,6 +901,7 @@ class CoverageEngine:
         self.corpus_cover = jax.device_put(self.corpus_cover, row)
         self.flakes = jax.device_put(self.flakes, row)
         self.corpus_mat = jax.device_put(self.corpus_mat, row)
+        self.corpus_seen = jax.device_put(self.corpus_seen, rep)
         self.prios = jax.device_put(self.prios, rep)
         self.enabled = jax.device_put(self.enabled, rep)
         self._ts_dummy = jax.device_put(self._ts_dummy, rep)
@@ -913,6 +927,7 @@ class CoverageEngine:
         k_translate = KERNELS.fn("translate_slab_rows", self.kernel_plane)
         k_sigdiff = KERNELS.fn("signal_diff", self.kernel_plane)
         k_sgather = KERNELS.fn("synth_gather", self.kernel_plane)
+        k_evict = KERNELS.fn("evict_score", self.kernel_plane)
 
         def _bump(svec, hinc, batch_slot, rows_slot, new_slot,
                   valid, has_new, extra=()):
@@ -1188,12 +1203,12 @@ class CoverageEngine:
                     jnp.sum(counts, dtype=jnp.int32) * 4)
             return cover, mat, has_new, rowbits, draws, miss_rows, svec
 
-        @functools.partial(jax.jit, donate_argnums=(0, 1, 2),
-                           static_argnums=(16, 17))
+        @functools.partial(jax.jit, donate_argnums=(0, 1, 2, 18),
+                           static_argnums=(16, 17, 20))
         def _fuzz_tick(max_cover, corpus_cover, corpus_mat, flakes, win,
                        counts, call_ids, start, key, prios, enabled,
                        prev, skeys, svals, meta, svec, direct_cap,
-                       overflow, hinc):
+                       overflow, seen, tick, tiered, hinc):
             """ONE whole fuzz tick in ONE dispatch: ingest-translate →
             signal diff/merge into max cover → admission gate + corpus
             merge → tsdb slot bumps → decision draws.  The unfused
@@ -1205,9 +1220,20 @@ class CoverageEngine:
             tick_batches marker — so fused-vs-unfused stays frontier
             bit-exact while the host boundary is crossed once.
 
-            Donates all three big matrices (max cover, corpus cover,
-            corpus signal matrix): steady-state ticks move only the
-            slab window in and verdict vectors out."""
+            With `tiered` (static — a per-engine-mode build decision,
+            like the kernel plane), the admission stage fuses the
+            eviction-score kernel: admits past the matrix cap redirect
+            into the highest-score (most shadowed, stalest) rows
+            instead of dropping, and the displaced contents ride out in
+            the same dispatch for the host to demote warm.  A victim
+            always scores ≥0 only when live (< start), so redirects
+            never collide with the within-cap append indices (which
+            are ≥ start); `attach_tiers` enforces cap ≥ 2·batch so a
+            full batch of redirects still finds live victims.
+
+            Donates the three big matrices plus the recency vector:
+            steady-state ticks move only the slab window in and
+            verdict vectors out."""
             idx, valid, miss = k_translate(
                 win, counts, skeys, svals, meta, direct_cap, overflow)
             bitmaps = pack_pcs(idx, valid, npcs, assume_unique=False)
@@ -1218,9 +1244,25 @@ class CoverageEngine:
             rowbits = popcount_rows(_new)
             rows = jnp.where(has_new[:, None], bitmaps, jnp.uint32(0))
             cover = scatter_or(corpus_cover, call_ids, rows)
-            ridx = jnp.cumsum(has_new.astype(jnp.int32)) - 1 + start
-            ridx = jnp.where(has_new, ridx, corpus_mat.shape[0])
+            B = call_ids.shape[0]
+            cap = corpus_mat.shape[0]
+            raw = jnp.cumsum(has_new.astype(jnp.int32)) - 1 + start
+            if tiered:
+                scores = k_evict(corpus_mat, seen, start, tick)
+                _sv, victims = jax.lax.top_k(scores, B)
+                evicted = corpus_mat[victims]
+                ovpos = jnp.clip(raw - cap, 0, B - 1)
+                ridx = jnp.where(raw < cap, raw, victims[ovpos])
+                n_evict = jnp.sum(has_new & (raw >= cap),
+                                  dtype=jnp.int32)
+            else:
+                victims = jnp.zeros((B,), jnp.int32)
+                evicted = jnp.zeros_like(bitmaps)
+                ridx = raw
+                n_evict = jnp.int32(0)
+            ridx = jnp.where(has_new, ridx, cap)
             mat = corpus_mat.at[ridx].set(bitmaps, mode="drop")
+            seen = seen.at[ridx].set(tick, mode="drop")
             draws = sample_calls(key, prios, prev, enabled)
             miss_rows = jnp.any(miss, axis=1)
             if ds is not None:
@@ -1238,8 +1280,31 @@ class CoverageEngine:
                 svec = svec.at[ds.slot("ingest_bytes")].add(
                     jnp.sum(counts, dtype=jnp.int32) * 4)
                 svec = svec.at[ds.slot("tick_batches")].add(1)
-            return (merged, cover, mat, sig_has, sig_new, has_new,
-                    rowbits, draws, miss_rows, svec)
+                if tiered:
+                    svec = svec.at[ds.slot("tier_evictions")].add(
+                        n_evict)
+            return (merged, cover, mat, seen, sig_has, sig_new, has_new,
+                    rowbits, draws, miss_rows, victims, evicted,
+                    n_evict, svec)
+
+        @jax.jit
+        def _evict_scores(corpus_mat, seen, nlive, tick):
+            return k_evict(corpus_mat, seen, nlive, tick)
+
+        @functools.partial(jax.jit, donate_argnums=(0, 1, 2))
+        def _swap_rows(corpus_cover, corpus_mat, seen, ridx, call_ids,
+                       new_rows, tick):
+            """Contents-only row replacement — the promotion half of
+            the tier swap.  ridx is padded with `cap` (out of range;
+            mode="drop" skips) and padded new_rows are zero (a no-op
+            under scatter_or), so every batch size dispatches through
+            ONE pow2-bucketed signature.  Returns the displaced row
+            contents for demotion."""
+            old = corpus_mat[jnp.clip(ridx, 0, corpus_mat.shape[0] - 1)]
+            mat = corpus_mat.at[ridx].set(new_rows, mode="drop")
+            seen = seen.at[ridx].set(tick, mode="drop")
+            cover = scatter_or(corpus_cover, call_ids, new_rows)
+            return cover, mat, seen, old
 
         @functools.partial(jax.jit, static_argnums=(8, 9))
         def _ingest_diff(base, flakes, win, counts, call_ids, skeys,
@@ -1544,6 +1609,8 @@ class CoverageEngine:
                     nkept, svec)
 
         self._fuzz_tick_fn = _fuzz_tick
+        self._evict_scores_fn = _evict_scores
+        self._swap_rows_fn = _swap_rows
         self._synth_fn = _synth
         self._random_bits_fn = _random_bits
         self._ingest_update_fn = _ingest_update
@@ -1755,16 +1822,27 @@ class CoverageEngine:
         n_in = int(call_ids.shape[0])
         prev = jnp.asarray(choice_prev, jnp.int32)
         if self.corpus_len + n_in > self.cap:
-            # matrix cannot take the whole batch: gate-only verdicts,
-            # nothing merges (the serial drop-the-input semantics)
-            new, has_new, _bm, _idx, miss_rows = self._ingest_diff_fn(
+            # matrix cannot take the whole batch: gate-only verdicts;
+            # untiered nothing merges (the serial drop-the-input
+            # semantics), tiered the admitted entries take demoted rows
+            new, has_new, bm, _idx, miss_rows = self._ingest_diff_fn(
                 self.corpus_cover, self.flakes, win, counts, call_ids,
                 skeys, svals, meta, dc, ov)
             if bool(np.asarray(miss_rows).any()):
                 raise ValueError("admit_slabs: unresolved first-sight "
                                  "keys (call mirror.ensure first)")
             choices = self.sample_next_calls(np.asarray(prev))
-            out = (np.asarray(has_new), None, choices,
+            has_new = np.asarray(has_new)
+            rows = None
+            if self.tiers is not None:
+                adm = np.nonzero(has_new)[0]
+                if (0 < len(adm) <= self.cap
+                        and self.corpus_len + len(adm) > self.cap):
+                    got = self.merge_corpus(np.asarray(call_ids)[adm],
+                                            np.asarray(bm)[adm])
+                    if got is not None:
+                        rows = np.asarray(got, np.int64)
+            out = (has_new, rows, choices,
                    np.asarray(self._popcount_fn(new)))
             return out if with_new_bits else out[:3]
         svec, hinc = self._ts_in()
@@ -1800,14 +1878,19 @@ class CoverageEngine:
         AFTER the signal merge (which is miss-tolerant) but before any
         admission bookkeeping is reported.
 
-        Falls back to the unfused pair when the corpus matrix cannot
-        take the whole batch (the serial drop-the-input semantics),
-        marked fused=False so callers/bench can count it."""
+        Without a tier manager attached, falls back to the unfused
+        pair when the corpus matrix cannot take the whole batch (the
+        serial drop-the-input semantics), marked fused=False so
+        callers/bench can count it.  With tiers attached
+        (attach_tiers) the fused dispatch always runs: over-cap admits
+        redirect into the eviction kernel's victims in-dispatch and
+        the displaced contents demote to the warm store — zero extra
+        host crossings, zero recompiles."""
         win, counts, call_ids = self._slab_fit(win, counts, call_ids)
         skeys, svals, meta, dc, ov = self._mirror_ops(mirror)
         n_in = int(call_ids.shape[0])
         prev = jnp.asarray(choice_prev, jnp.int32)
-        if self.corpus_len + n_in > self.cap:
+        if self.corpus_len + n_in > self.cap and self.tiers is None:
             svec, hinc = self._ts_in()
             (self.max_cover, sig_new, sig_has, miss_rows,
              svec) = self._ingest_update_fn(
@@ -1827,27 +1910,51 @@ class CoverageEngine:
                 choices=np.asarray(choices),
                 new_bits=np.asarray(self._popcount_fn(new)),
                 miss_rows=miss_rows, fused=False)
+        tiered = self.tiers is not None
+        tick = self._tick
         svec, hinc = self._ts_in()
-        (self.max_cover, self.corpus_cover, self.corpus_mat, sig_has,
-         sig_new, has_new, nbits, choices, miss_rows,
+        (self.max_cover, self.corpus_cover, self.corpus_mat,
+         self.corpus_seen, sig_has, sig_new, has_new, nbits, choices,
+         miss_rows, victims, evicted, _n_ev,
          svec) = self._fuzz_tick_fn(
             self.max_cover, self.corpus_cover, self.corpus_mat,
             self.flakes, win, counts, call_ids,
             jnp.int32(self.corpus_len), self._next_key(), self.prios,
-            self.enabled, prev, skeys, svals, meta, svec, dc, ov, hinc)
+            self.enabled, prev, skeys, svals, meta, svec, dc, ov,
+            self.corpus_seen, jnp.int32(tick), tiered, hinc)
         self._ts_out(svec)
+        self._tick = tick + 1
         has_new = np.asarray(has_new)
         if bool(np.asarray(miss_rows).any()):
             raise ValueError("fuzz_tick: unresolved first-sight keys "
                              "(call mirror.ensure first)")
         admitted = np.nonzero(has_new)[0]
-        rows = np.arange(self.corpus_len, self.corpus_len + len(admitted))
+        n_adm = len(admitted)
+        free = self.cap - self.corpus_len
+        n_over = 0
+        if n_adm <= free:
+            rows = np.arange(self.corpus_len, self.corpus_len + n_adm)
+            self.corpus_len += n_adm
+        else:
+            # over-cap admits were redirected in-dispatch into the
+            # eviction kernel's victims, in admission order; demote
+            # the displaced contents before rebinding their slots
+            n_over = n_adm - free
+            victims_np = np.asarray(victims, np.int64)[:n_over]
+            rows = np.empty((n_adm,), np.int64)
+            rows[:free] = np.arange(self.corpus_len, self.cap)
+            rows[free:] = victims_np
+            self.tiers.on_evicted(
+                victims_np, np.asarray(evicted)[:n_over],
+                self.corpus_call[victims_np].copy(),
+                np.full((n_over,), tick, np.int64))
+            self.corpus_len = self.cap
         self.corpus_call[rows] = np.asarray(call_ids)[admitted]
-        self.corpus_len += len(admitted)
         return FuzzTickResult(
             sig_has_new=sig_has, sig_new_bits=sig_new, has_new=has_new,
             rows=rows, choices=np.asarray(choices),
-            new_bits=np.asarray(nbits), miss_rows=miss_rows)
+            new_bits=np.asarray(nbits), miss_rows=miss_rows,
+            n_evicted=n_over)
 
     def triage_diff_slabs(self, win, counts, call_ids, mirror):
         """Slab-path triage gate: translate + diff vs corpus cover
@@ -1925,7 +2032,9 @@ class CoverageEngine:
         merge into corpus cover and append matrix rows.  Returns
         (has_new, assigned row indices aligned to the admitted entries
         in submission order) — rows is None when the matrix is full, in
-        which case NOTHING merges (manager drop-the-input semantics).
+        which case NOTHING merges (manager drop-the-input semantics)
+        UNLESS a tier manager is attached: then the admitted entries
+        take demoted rows (contents-only swap) and rows comes back.
         The capacity check is conservative — the whole batch must fit,
         since the admitted count is only known after the dispatch.
         With with_new_bits=True a third element is returned: (B,) int32
@@ -1955,11 +2064,27 @@ class CoverageEngine:
         call_ids, pc_idx, valid = self._fit(call_ids, pc_idx, valid)
         n_in = int(call_ids.shape[0])
         if self.corpus_len + n_in > self.cap:
-            new, has_new, _bm = self._diff_vs_fn(
+            new, has_new, bm = self._diff_vs_fn(
                 self.corpus_cover, call_ids, pc_idx, valid, self.flakes)
             choices = (self.sample_next_calls(choice_prev)
                        if choice_prev is not None else None)
-            return (np.asarray(has_new), None, choices,
+            has_new = np.asarray(has_new)
+            rows = None
+            if self.tiers is not None:
+                # tiered: admitted entries take demoted rows instead of
+                # dropping (merge_corpus swaps through the pow2-padded
+                # swap_rows dispatch — no new signatures).  The guard
+                # keeps the subset on merge_corpus's swap branch; a
+                # subset that still fits free rows drops as before
+                # (transient: a saturated matrix never has free rows)
+                adm = np.nonzero(has_new)[0]
+                if (0 < len(adm) <= self.cap
+                        and self.corpus_len + len(adm) > self.cap):
+                    got = self.merge_corpus(np.asarray(call_ids)[adm],
+                                            np.asarray(bm)[adm])
+                    if got is not None:
+                        rows = np.asarray(got, np.int64)
+            return (has_new, rows, choices,
                     np.asarray(self._popcount_fn(new)))
         svec, hinc = self._ts_in()
         if choice_prev is None:
@@ -2007,13 +2132,32 @@ class CoverageEngine:
                      cover_only_when_full: bool = False
                      ) -> "np.ndarray | None":
         """Admit execs into corpus cover + the corpus signal matrix.
-        Returns indices assigned.  When the matrix is full: with
-        cover_only_when_full the cover bitmap still merges (callers that
-        keep the program anyway need the gate to stay truthful) and None
-        is returned; otherwise nothing merges, so the coverage stays
-        re-discoverable later (manager drop-the-input semantics)."""
+        Returns indices assigned.  When the matrix is full: with a
+        tier manager attached the lowest-retention rows demote to the
+        warm store and the batch takes their slots (contents-only swap
+        — never a recompile); otherwise with cover_only_when_full the
+        cover bitmap still merges (callers that keep the program
+        anyway need the gate to stay truthful) and None is returned,
+        else nothing merges, so the coverage stays re-discoverable
+        later (manager drop-the-input semantics)."""
         n = int(bitmaps.shape[0])
         if self.corpus_len + n > self.cap:
+            if self.tiers is not None and n <= self.cap:
+                free = self.cap - self.corpus_len
+                n_over = n - free
+                vict = np.empty((n,), np.int64)
+                vict[:free] = np.arange(self.corpus_len, self.cap)
+                order = np.argsort(self.evict_scores(),
+                                   kind="stable")[::-1]
+                vict[free:] = order[:n_over]
+                old_calls = self.corpus_call[vict[free:]].copy()
+                old_rows = self.swap_rows(vict, np.asarray(bitmaps),
+                                          np.asarray(call_ids))
+                self.tiers.on_evicted(
+                    vict[free:], old_rows[free:], old_calls,
+                    np.full((n_over,), self._tick, np.int64))
+                self.corpus_len = self.cap
+                return vict
             if cover_only_when_full:
                 call_ids = jnp.asarray(call_ids, jnp.int32)
                 self.corpus_cover = self._or_rows_fn(
@@ -2028,6 +2172,67 @@ class CoverageEngine:
         self.corpus_call[idx] = np.asarray(call_ids)
         self.corpus_len += n
         return idx
+
+    # -- tiered corpus hierarchy (corpus/tiers.py) ------------------------
+
+    def attach_tiers(self, tiers) -> None:
+        """Attach a TierManager: admission past corpus_cap now demotes
+        the lowest-retention rows warm instead of falling back unfused
+        (fuzz_tick) or dropping (merge_corpus).  cap ≥ 2·batch keeps
+        the fused redirect collision-free: a full batch of over-cap
+        admits still finds its victims among live rows below the
+        append window."""
+        if self.cap < 2 * self.batch:
+            raise ValueError(
+                f"attach_tiers: corpus_cap {self.cap} < 2*batch "
+                f"{2 * self.batch} cannot guarantee collision-free "
+                "in-dispatch eviction")
+        self.tiers = tiers
+        tiers.bind(self)
+
+    @property
+    def tick(self) -> int:
+        """Monotonic fused-tick counter — the recency clock the
+        eviction score decays against."""
+        return self._tick
+
+    def evict_scores(self) -> np.ndarray:
+        """(cap,) per-row eviction scores (one dispatch of the
+        registered evict_score kernel; -1 marks dead slots).  Higher =
+        evict first."""
+        with self._state_mu:
+            dev = self._evict_scores_fn(
+                self.corpus_mat, self.corpus_seen,
+                jnp.int32(self.corpus_len), jnp.int32(self._tick))
+        return np.asarray(dev)
+
+    @_locked
+    def swap_rows(self, rows, bitmaps, call_ids) -> np.ndarray:
+        """Replace corpus rows' CONTENTS in place (the DeviceKeyMirror
+        contents-only growth pattern): the tier swap primitive.  Pads
+        to a pow2 bucket so any batch size reuses one dispatch
+        signature; merges the incoming rows into corpus cover; bumps
+        the rows' recency to the current tick.  Returns the displaced
+        (n, W) row contents (the demotion payload)."""
+        rows = np.asarray(rows, np.int64)
+        n = len(rows)
+        if n == 0:
+            return np.zeros((0, self.W), np.uint32)
+        p2 = pow2_bucket(n, 8, max(8, self.cap))
+        ridx = np.full((p2,), self.cap, np.int64)
+        ridx[:n] = rows
+        bm = np.zeros((p2, self.W), np.uint32)
+        bm[:n] = np.asarray(bitmaps, np.uint32)
+        cid = np.zeros((p2,), np.int32)
+        cid[:n] = np.asarray(call_ids, np.int32)
+        (self.corpus_cover, self.corpus_mat, self.corpus_seen,
+         old) = self._swap_rows_fn(
+            self.corpus_cover, self.corpus_mat, self.corpus_seen,
+            jnp.asarray(ridx, jnp.int32), jnp.asarray(cid),
+            jnp.asarray(bm), jnp.int32(self._tick))
+        self.corpus_call[rows] = cid[:n]
+        self.corpus_len = max(self.corpus_len, int(rows.max()) + 1)
+        return np.asarray(old)[:n].copy()
 
     # above this row count the exact greedy's per-pick argmax passes over
     # the whole (C, W) matrix dominate; switch to the single-scan cover
@@ -2074,7 +2279,13 @@ class CoverageEngine:
         new_call = np.zeros_like(self.corpus_call)
         new_call[:n] = self.corpus_call[old_rows]
         self.corpus_call = new_call
+        seen = np.asarray(self.corpus_seen)
+        new_seen = np.zeros_like(seen)
+        new_seen[:n] = seen[old_rows]
+        self.corpus_seen = self.put_replicated(new_seen)
         self.corpus_len = n
+        if self.tiers is not None:
+            self.tiers.on_compacted(mapping)
         return mapping
 
     def set_priorities(self, static_prios: np.ndarray,
@@ -2287,6 +2498,8 @@ class CoverageEngine:
             # snapshot/failover — a slow retrace treadmill)
             "corpus_mat": np.asarray(self.corpus_mat)[:n].copy(),
             "corpus_call": self.corpus_call[:n].copy(),
+            "corpus_seen": np.asarray(self.corpus_seen)[:n].copy(),
+            "tick": self._tick,
             "prios": np.asarray(self.prios),
             "enabled": np.asarray(self.enabled),
         }
@@ -2323,6 +2536,13 @@ class CoverageEngine:
         self.corpus_mat = put(mat, row)
         self.corpus_call = np.zeros((self.cap,), np.int32)
         self.corpus_call[:n] = np.asarray(state["corpus_call"], np.int32)
+        # pre-tier snapshots (codec v1) carry no recency state: zeros =
+        # maximally old, so restored rows are simply first to demote
+        seen = np.zeros((self.cap,), np.int32)
+        if "corpus_seen" in state:
+            seen[:n] = np.asarray(state["corpus_seen"], np.int32)
+        self.corpus_seen = put(seen, rep)
+        self._tick = int(state.get("tick", 0))
         self.corpus_len = n
         self.prios = put(np.asarray(state["prios"], np.float32), rep)
         self.enabled = put(np.asarray(state["enabled"], bool), rep)

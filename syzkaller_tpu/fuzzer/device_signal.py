@@ -103,6 +103,10 @@ class DeviceSignal:
         # keeps growing, so the identity mapping is not guaranteed)
         self._row2corpus: list[int] = []
         self._row_mu = threading.Lock()
+        # tiered corpus hierarchy (corpus.TierManager | None): attached
+        # via attach_tiers — over-cap admission then demotes warm
+        # instead of dropping, and warm rows promote back on resolve
+        self.tiers = None
         # active campaign frontier (cover.engine.SparseView | None):
         # resolve() absorbs each batch's new-signal diffs into it, so
         # per-campaign coverage rides the dispatches the hot loop
@@ -117,6 +121,38 @@ class DeviceSignal:
         """Install the campaign frontier view new signal is attributed
         to from now on (None = stop attributing)."""
         self._frontier = view
+
+    def attach_tiers(self, tiers) -> None:
+        """Wire a corpus.TierManager through the engine: admission past
+        corpus_cap demotes the lowest-retention rows to the warm store
+        (in the fused tick dispatch) instead of falling back unfused,
+        and resolve_corpus_rows promotes warm-resident entries back."""
+        self.tiers = tiers
+        self.engine.attach_tiers(tiers)
+
+    def resolve_corpus_rows(self, corpus_indices) -> np.ndarray:
+        """Corpus indices -> hot device rows, promoting warm-resident
+        entries first (at most ONE batched segment read + ONE swap
+        dispatch); -1 = cold (replay through the persistent corpus)."""
+        if self.tiers is None:
+            return np.full((len(corpus_indices),), -1, np.int64)
+        return self.tiers.resolve_rows(corpus_indices)
+
+    def _record_rows(self, rows, owners) -> None:
+        """Bind device corpus rows to caller corpus indices.  The map
+        is positional, not append-only: tiered admission replaces row
+        CONTENTS in place, so a row index can be rebound."""
+        rows = np.asarray(rows, np.int64)
+        owners = np.asarray(owners, np.int64)
+        with self._row_mu:
+            r2c = self._row2corpus
+            top = int(rows.max()) + 1
+            if top > len(r2c):
+                r2c.extend([-1] * (top - len(r2c)))
+            for r, o in zip(rows, owners):
+                r2c[int(r)] = int(o)
+        if self.tiers is not None:
+            self.tiers.set_owners(rows, owners)
 
     # -- mapping helpers ---------------------------------------------------
 
@@ -240,8 +276,7 @@ class DeviceSignal:
             owners = (np.full(len(res.rows), -1, np.int64)
                       if corpus_indices is None
                       else np.asarray(corpus_indices)[res.has_new])
-            with self._row_mu:
-                self._row2corpus.extend(int(x) for x in owners)
+            self._record_rows(res.rows, owners)
         elif res.rows is None:
             self.stat_corpus_full += 1
         if decision_sink is not None:
@@ -425,15 +460,19 @@ class DeviceSignal:
         self.mirror.ensure(pcs)
         bitmap = self.engine.pack_or_slabs(win, counts, self.mirror)
         call_ids = np.full((1,), call_id, np.int32)
-        with self._row_mu:
-            rows = self.engine.merge_corpus(call_ids, bitmap,
-                                            cover_only_when_full=True)
-            if rows is not None:
-                # ALWAYS record the row (placeholder -1 when the caller
-                # tracks no corpus index) — skipping would shift every
-                # later row's mapping by one
-                self._row2corpus.append(
-                    -1 if corpus_index is None else int(corpus_index))
+        rows = self.engine.merge_corpus(call_ids, bitmap,
+                                        cover_only_when_full=True)
+        if rows is not None:
+            # ALWAYS record the row (placeholder -1 when the caller
+            # tracks no corpus index): the positional map must stay
+            # truthful for rows with no owner too.  With tiers the
+            # returned row may be a reused (demoted) slot — the
+            # positional write rebinds it.
+            self._record_rows(
+                np.asarray(rows, np.int64),
+                np.full((len(rows),),
+                        -1 if corpus_index is None else int(corpus_index),
+                        np.int64))
         if rows is None:
             self.stat_corpus_full += 1
             if self.stat_corpus_full == 1:

@@ -61,6 +61,12 @@ class DeviceSynth:
         # host canonicals (fixed capacity; contents grow)
         self._rows: list[PS.EncodedProgram] = []
         self._tmpls: list[PS.EncodedProgram] = []
+        # score-driven bank replacement (tiered-corpus fold-in): each
+        # row carries rank = score·2^20 + admit-seq and the LOWEST rank
+        # is replaced first, so score-less admission degenerates to
+        # oldest-first instead of the old always-slot-0 rewrite
+        self._row_rank = np.full((self.R,), np.inf)
+        self._row_seq = 0
         self._h = {
             "rows_lo": np.zeros((self.R, self.L), np.uint32),
             "rows_hi": np.zeros((self.R, self.L), np.uint32),
@@ -127,10 +133,14 @@ class DeviceSynth:
             self._dev = None
         return True
 
-    def add_program(self, p: M.Prog) -> bool:
+    def add_program(self, p: M.Prog, score: "float | None" = None
+                    ) -> bool:
         """Admit a triaged program into the device corpus table (the
-        growth loop's host fix-up).  Rows replace FIFO once the table
-        is full — replacement rewrites contents, never shapes.
+        growth loop's host fix-up).  Once the table is full,
+        replacement is score-driven: the lowest-rank row (rank =
+        signal score · 2^20 + admit sequence — the eviction-score
+        retention order; score-less callers degenerate to
+        oldest-first) rewrites its contents, never shapes.
         Returns False for ineligible programs (they stay host-side)."""
         enc = PS.encode_program(p, self.table)
         if enc is None or enc.nwords == 0 or enc.nwords > self.L - 1 \
@@ -138,12 +148,16 @@ class DeviceSynth:
             self.stat_rows_rejected += 1
             return False
         with self._mu:
+            self._row_seq += 1
+            rank = ((0.0 if score is None else float(score)) * 2.0**20
+                    + self._row_seq)
             if len(self._rows) < self.R:
                 r = len(self._rows)
                 self._rows.append(enc)
             else:
-                r = int(self._h["meta"][0]) % self.R
+                r = int(np.argmin(self._row_rank))
                 self._rows[r] = enc
+            self._row_rank[r] = rank
             h = self._h
             w = enc.words
             h["rows_lo"][r] = 0

@@ -10,16 +10,20 @@ plane rules and the zero-recompile failover contract.
 
 from __future__ import annotations
 
-from syzkaller_tpu.kernels.oracles import (popcount_rows, signal_diff,
-                                           synth_gather,
+from syzkaller_tpu.kernels.oracles import (evict_score, popcount_rows,
+                                           signal_diff, synth_gather,
                                            translate_slab_rows)
-from syzkaller_tpu.kernels.pallas_plane import (signal_diff_pallas,
+from syzkaller_tpu.kernels.pallas_plane import (evict_score_pallas,
+                                                signal_diff_pallas,
                                                 synth_gather_pallas,
                                                 translate_slab_rows_pallas)
 from syzkaller_tpu.kernels.registry import (KernelRegistry, KernelSpec,
                                             TPU_BACKENDS)
 
 KERNELS = KernelRegistry()
+KERNELS.register(
+    "evict_score", oracle=evict_score, pallas=evict_score_pallas,
+    parity_test="tests/test_kernels.py::test_evict_score_parity")
 KERNELS.register(
     "signal_diff", oracle=signal_diff, pallas=signal_diff_pallas,
     parity_test="tests/test_kernels.py::test_signal_diff_parity")
@@ -32,6 +36,7 @@ KERNELS.register(
     parity_test="tests/test_kernels.py::test_synth_gather_parity")
 
 __all__ = ["KERNELS", "KernelRegistry", "KernelSpec", "TPU_BACKENDS",
-           "popcount_rows", "signal_diff", "synth_gather",
-           "translate_slab_rows", "signal_diff_pallas",
-           "synth_gather_pallas", "translate_slab_rows_pallas"]
+           "evict_score", "popcount_rows", "signal_diff", "synth_gather",
+           "translate_slab_rows", "evict_score_pallas",
+           "signal_diff_pallas", "synth_gather_pallas",
+           "translate_slab_rows_pallas"]

@@ -86,6 +86,45 @@ def translate_slab_rows(win: jax.Array, counts: jax.Array,
     return idx, valid, miss
 
 
+def evict_score(mat: jax.Array, seen: jax.Array, nlive: jax.Array,
+                tick: jax.Array) -> jax.Array:
+    """Per-row eviction score for the hot-tier signal matrix — the
+    device-side analog of the reference's corpus minimization
+    (manager.go:504-527, "drop inputs whose signal is shadowed").
+
+    mat: (C, W) uint32 corpus signal rows.  seen: (C,) int32 last-admit
+    tick per row (0 = never refreshed, i.e. maximally old).  nlive:
+    scalar int32 live-row count.  tick: scalar int32 current tick.
+
+    A bit is *shadowed* when ≥2 live rows cover it: the once/twice
+    accumulator scan (`twice |= once & row; once |= row`) marks those
+    bits, and a row's shadowed count is popcount(row & twice).  The
+    count is decayed by admit recency — a just-admitted row scores 0
+    however redundant its signal, an old one scores in full:
+
+        age   = clip(tick - seen, 0, 255)
+        score = clip(shadowed, 0, 0x3FFF) * age * 256 + age
+
+    (max 0x3FFF*255*256 + 255 < 2^31, so int32 holds it; the +age term
+    breaks ties among unshadowed rows toward the stalest).  Dead slots
+    (i >= nlive) score -1 so a top-k victim pick never lands on a slot
+    the same dispatch's append path is filling.  Higher = evict first."""
+    C, W = mat.shape
+    live = jnp.arange(C, dtype=jnp.int32) < nlive
+    rows = jnp.where(live[:, None], mat, jnp.uint32(0))
+
+    def step(carry, row):
+        once, twice = carry
+        return (once | row, twice | (once & row)), None
+
+    zero = jnp.zeros((W,), jnp.uint32)
+    (_once, twice), _ = jax.lax.scan(step, (zero, zero), rows)
+    shadowed = popcount_rows(rows & twice[None, :])
+    age = jnp.clip(tick - seen, 0, 255).astype(jnp.int32)
+    score = jnp.clip(shadowed, 0, 0x3FFF) * age * 256 + age
+    return jnp.where(live, score, jnp.int32(-1))
+
+
 def synth_gather(ends: jax.Array, starts: jax.Array, sstart: jax.Array,
                  row: jax.Array, is_t: jax.Array, total: jax.Array,
                  rows_lo: jax.Array, rows_hi: jax.Array,

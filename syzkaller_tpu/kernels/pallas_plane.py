@@ -36,6 +36,7 @@ import functools
 import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
 
 
 def _tile(n: int, cap: int) -> int:
@@ -167,84 +168,169 @@ def translate_slab_rows_pallas(win, counts, skeys, svals, meta,
       skeys.reshape(1, D), svals.reshape(1, D), meta.reshape(1, 2))
 
 
+# -- evict_score ------------------------------------------------------------
+
+
+def _evict_shadow_body(mat_ref, once_ref, twice_ref):
+    j = pl.program_id(1)
+
+    @pl.when(j == 0)
+    def _init():
+        once_ref[...] = jnp.zeros_like(once_ref)
+        twice_ref[...] = jnp.zeros_like(twice_ref)
+
+    block = mat_ref[...]                     # (TC, TW)
+
+    def step(r, carry):
+        once, twice = carry
+        row = jax.lax.dynamic_slice_in_dim(block, r, 1, axis=0)
+        return once | row, twice | (once & row)
+
+    once, twice = jax.lax.fori_loop(
+        0, block.shape[0], step, (once_ref[...], twice_ref[...]))
+    once_ref[...] = once
+    twice_ref[...] = twice
+
+
+def _evict_count_body(mat_ref, twice_ref, acc_ref):
+    w = pl.program_id(1)
+
+    @pl.when(w == 0)
+    def _init():
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+
+    masked = jnp.bitwise_and(mat_ref[...], twice_ref[...])
+    acc_ref[...] += jax.lax.population_count(masked).sum(
+        axis=1, dtype=jnp.int32)[:, None]
+
+
+def evict_score_pallas(mat, seen, nlive, tick, *,
+                       interpret: bool = False):
+    """Two-pass shadowed-signal scoring.
+
+    Pass A builds the once/twice accumulators: grid (W/TW, C/TC) with
+    the ROW axis inner, so the revisited (1, TW) accumulator blocks see
+    consecutive visits while (TC, TW) matrix tiles stream through VMEM;
+    rows fold in order via a fori_loop over the tile (the once->twice
+    carry is order-dependent within a word column, never across
+    columns, so word tiles parallelize freely).  Pass B mirrors
+    signal_diff's fused popcount-reduce: popcount(row & twice)
+    accumulates into a revisited (TC, 1) block across the W axis.  The
+    cheap elementwise recency decay stays in jnp."""
+    C, W = mat.shape
+    TC, TW = _tile(C, 128), _tile(W, 512)
+    live = (jnp.arange(C, dtype=jnp.int32) <
+            jnp.asarray(nlive, jnp.int32))
+    rows = jnp.where(live[:, None], mat, jnp.uint32(0))
+    _once, twice = pl.pallas_call(
+        _evict_shadow_body,
+        grid=(W // TW, C // TC),
+        in_specs=[pl.BlockSpec((TC, TW), lambda w, j: (j, w))],
+        out_specs=[pl.BlockSpec((1, TW), lambda w, j: (0, w)),
+                   pl.BlockSpec((1, TW), lambda w, j: (0, w))],
+        out_shape=[jax.ShapeDtypeStruct((1, W), jnp.uint32),
+                   jax.ShapeDtypeStruct((1, W), jnp.uint32)],
+        interpret=interpret,
+    )(rows)
+    shadowed = pl.pallas_call(
+        _evict_count_body,
+        grid=(C // TC, W // TW),
+        in_specs=[pl.BlockSpec((TC, TW), lambda i, w: (i, w)),
+                  pl.BlockSpec((1, TW), lambda i, w: (0, w))],
+        out_specs=pl.BlockSpec((TC, 1), lambda i, w: (i, 0)),
+        out_shape=jax.ShapeDtypeStruct((C, 1), jnp.int32),
+        interpret=interpret,
+    )(rows, twice)[:, 0]
+    age = jnp.clip(jnp.asarray(tick, jnp.int32) - seen,
+                   0, 255).astype(jnp.int32)
+    score = jnp.clip(shadowed, 0, 0x3FFF) * age * 256 + age
+    return jnp.where(live, score, jnp.int32(-1))
+
+
 # -- synth_gather -----------------------------------------------------------
 
 
-def _synth_body(L, CO, R, Tn, LT,
-                ends_ref, starts_ref, sstart_ref, row_ref, ist_ref,
-                tot_ref, rlo_ref, rhi_ref, tlo_ref, thi_ref,
+def _synth_body(L, LT, CO,
+                rowc_ref, rowt_ref, starts_ref, ends_ref, sstart_ref,
+                ist_ref, tot_ref,
+                rlo_ref, rhi_ref, tlo_ref, thi_ref,
                 lo_ref, hi_ref):
-    ends = ends_ref[...]
-    TB = ends.shape[0]
-    j = jax.lax.broadcasted_iota(jnp.int32, (TB, L), 1)
-    # searchsorted(ends_i, j, 'right') == #{e : ends[e] <= j}: the
-    # compare-count form — CO is small, so one vectorized compare over
-    # the segment axis beats a per-element search on the VPU
-    e = jnp.sum((ends[:, None, :] <= j[:, :, None]).astype(jnp.int32),
-                axis=2)
-    e = jnp.clip(e, 0, CO - 1)
-    onehot = (e[:, :, None] ==
-              jax.lax.broadcasted_iota(jnp.int32, (TB, L, CO), 2)
-              ).astype(jnp.int32)
-
-    def pick(v):   # (TB, CO) per-segment scalar -> its value at e
-        return jnp.sum(onehot * v[:, None, :], axis=2)
-
-    off = pick(sstart_ref[...]) + (j - pick(starts_ref[...]))
-    rsel = pick(row_ref[...])
-    ist = pick(ist_ref[...].astype(jnp.int32)) > 0
-    rc = jnp.clip(rsel, 0, R - 1)
-    rt = jnp.clip(rsel, 0, Tn - 1)
-    # row-table gathers: fancy-indexed loads from the VMEM-resident
-    # banks.  On a physical TPU the corpus bank would ride scalar
-    # prefetch (PrefetchScalarGridSpec) once R*L outgrows VMEM; the
-    # interpret path and small banks take the direct gather.
-    rows_lo = rlo_ref[...]
-    rows_hi = rhi_ref[...]
-    t_lo = tlo_ref[...]
-    t_hi = thi_ref[...]
-    off_r = jnp.clip(off, 0, L - 1)
-    off_t = jnp.clip(off, 0, LT - 1)
-    lo = jnp.where(ist, t_lo[rt, off_t], rows_lo[rc, off_r])
-    hi = jnp.where(ist, t_hi[rt, off_t], rows_hi[rc, off_r])
-    total = tot_ref[...]                     # (TB, 1)
+    i = pl.program_id(0)
+    e = pl.program_id(1)
+    j = jax.lax.broadcasted_iota(jnp.int32, (1, L), 1)
+    total = tot_ref[i]
     eof = jnp.uint32(0xFFFFFFFF)
-    lo_ref[...] = jnp.where(j < total, lo,
-                            jnp.where(j == total, eof, jnp.uint32(0)))
-    hi_ref[...] = jnp.where(j < total, hi,
-                            jnp.where(j == total, eof, jnp.uint32(0)))
+
+    @pl.when(e == 0)
+    def _init():
+        base = jnp.where(j == total, eof, jnp.uint32(0))
+        lo_ref[...] = base
+        hi_ref[...] = base
+
+    # the oracle assigns word j the segment `clip(#{ends <= j}, 0,
+    # CO-1)`: segment e owns [ends[e-1], ends[e]), segment 0 starts at
+    # word 0, and the last segment extends unbounded (the j >= total
+    # tail is masked off by the init pattern staying in place)
+    prev_end = jnp.where(e == 0, jnp.int32(0),
+                         ends_ref[i, jnp.maximum(e - 1, 0)])
+    upper = jnp.where(e == CO - 1, jnp.int32(L),
+                      ends_ref[i, jnp.minimum(e, CO - 1)])
+    live = (j >= prev_end) & (j < upper) & (j < total)
+
+    off = sstart_ref[i, e] + (j - starts_ref[i, e])
+    ist = ist_ref[i, e] > 0
+    src_c = rlo_ref[0, jnp.clip(off, 0, L - 1)]
+    src_t = tlo_ref[0, jnp.clip(off, 0, LT - 1)]
+    lo = jnp.where(ist, src_t, src_c)
+    src_c = rhi_ref[0, jnp.clip(off, 0, L - 1)]
+    src_t = thi_ref[0, jnp.clip(off, 0, LT - 1)]
+    hi = jnp.where(ist, src_t, src_c)
+    lo_ref[...] = jnp.where(live, lo, lo_ref[...])
+    hi_ref[...] = jnp.where(live, hi, hi_ref[...])
 
 
 def synth_gather_pallas(ends, starts, sstart, row, is_t, total,
                         rows_lo, rows_hi, t_lo, t_hi, *,
                         interpret: bool = False):
-    """Tiled assembly gather: (TB, CO) program descriptors stream
-    through VMEM while the corpus/template word banks stay resident
-    (constant index_map); segment lookup is the compare-count
-    searchsorted and per-segment scalars resolve through a one-hot
-    select — the (TB, L, CO) one-hot is the VPU-friendly gather for a
-    small CO segment axis."""
+    """Scalar-prefetch assembly gather: the corpus/template word banks
+    stay in HBM and only the (1, L) row each segment actually sources
+    streams into VMEM — the program descriptors ride scalar prefetch
+    (`pltpu.PrefetchScalarGridSpec`), so the bank-row index_maps can
+    read them before the body runs and the pipeline double-buffers
+    segment e+1's row DMA behind segment e's compute.  Replaces the
+    whole-bank constant-index_map residency the PR-16 plane used, which
+    stopped fitting VMEM once score-driven replacement let the banks
+    grow HBM-sized.
+
+    Grid (B, CO): output (1, L) blocks are revisited across the inner
+    segment axis — initialized once with the EOF/zero tail pattern,
+    then each segment masks in its [ends[e-1], ends[e]) span."""
     B, CO = ends.shape
     R, L = rows_lo.shape
     Tn, LT = t_lo.shape
-    TB = _tile(B, 8)
-    body = functools.partial(_synth_body, L, CO, R, Tn, LT)
-    desc = pl.BlockSpec((TB, CO), lambda i: (i, 0))
+    rowc = jnp.clip(row, 0, R - 1).astype(jnp.int32)
+    rowt = jnp.clip(row, 0, Tn - 1).astype(jnp.int32)
+    body = functools.partial(_synth_body, L, LT, CO)
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=7,
+        grid=(B, CO),
+        in_specs=[
+            pl.BlockSpec((1, L), lambda i, e, rc, rt, *_s: (rc[i, e], 0)),
+            pl.BlockSpec((1, L), lambda i, e, rc, rt, *_s: (rc[i, e], 0)),
+            pl.BlockSpec((1, LT), lambda i, e, rc, rt, *_s: (rt[i, e], 0)),
+            pl.BlockSpec((1, LT), lambda i, e, rc, rt, *_s: (rt[i, e], 0)),
+        ],
+        out_specs=[pl.BlockSpec((1, L), lambda i, e, *_s: (i, 0)),
+                   pl.BlockSpec((1, L), lambda i, e, *_s: (i, 0))],
+    )
     lo, hi = pl.pallas_call(
         body,
-        grid=(B // TB,),
-        in_specs=[desc, desc, desc, desc, desc,
-                  pl.BlockSpec((TB, 1), lambda i: (i, 0)),
-                  pl.BlockSpec((R, L), lambda i: (0, 0)),
-                  pl.BlockSpec((R, L), lambda i: (0, 0)),
-                  pl.BlockSpec((Tn, LT), lambda i: (0, 0)),
-                  pl.BlockSpec((Tn, LT), lambda i: (0, 0))],
-        out_specs=[pl.BlockSpec((TB, L), lambda i: (i, 0)),
-                   pl.BlockSpec((TB, L), lambda i: (i, 0))],
+        grid_spec=grid_spec,
         out_shape=[jax.ShapeDtypeStruct((B, L), jnp.uint32),
                    jax.ShapeDtypeStruct((B, L), jnp.uint32)],
         interpret=interpret,
-    )(ends, starts, sstart, row, is_t,
-      total.reshape(B, 1).astype(jnp.int32),
+    )(rowc, rowt, starts.astype(jnp.int32), ends.astype(jnp.int32),
+      sstart.astype(jnp.int32), is_t.astype(jnp.int32),
+      total.astype(jnp.int32),
       rows_lo, rows_hi, t_lo, t_hi)
     return lo, hi

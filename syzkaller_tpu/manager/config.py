@@ -80,6 +80,16 @@ class Config:
     #                                    (0 = never rotate)
     campaign_min_execs: int = 2000     # rotation arms only after this
     #                                    many execs under the campaign
+    # tiered corpus (hot device tables / warm mmap'd segment log /
+    # cold persistent corpus)
+    corpus_tiers: bool = False         # attach a TierManager: over-cap
+    #                                    admissions demote eviction-kernel
+    #                                    victims to workdir/warm through
+    #                                    the fused tick instead of falling
+    #                                    back to the unfused admit path;
+    #                                    warm rows promote back by
+    #                                    contents-only swaps (zero warm
+    #                                    recompiles)
     # resilience plane (fault tolerance)
     snapshot_interval: float = 300.0   # crash-only state snapshot cadence
     #                                    (workdir/snapshots/; 0 = off —

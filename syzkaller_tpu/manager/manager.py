@@ -272,6 +272,10 @@ class Manager:
         self.checkpointer = Checkpointer(
             self, interval=cfg.snapshot_interval, keep=cfg.snapshot_keep,
             registry=self.registry)
+        # tiered corpus: the TierManager is created inside the restore
+        # path because the warm store wants the v2 snapshot's segment
+        # refs (if any) to pin what it expects to resurface
+        self.tiers = None
         self._restore_state()
         # dedup state survives restarts: rebuild crash_types and the
         # cluster index from workdir/crashes/ before VMs come up (the
@@ -391,6 +395,7 @@ class Manager:
         if st is None:
             self.candidates = deque(self.persistent.values())
             self._f_restore.labels(outcome="cold").inc()
+            self._attach_tiers(None)
             return
         if st.corrupt_skipped:
             self._c_snapshot_corrupt.inc(st.corrupt_skipped)
@@ -430,7 +435,12 @@ class Manager:
                      "replay", os.path.basename(st.path), e)
             self.candidates = deque(self.persistent.values())
             self._f_restore.labels(outcome="cold").inc()
+            self._attach_tiers(None)
             return
+        # warm tier: the v2 snapshot names the segments it expects the
+        # warm store to resurface; a v1 snapshot has no refs and the
+        # store simply mounts whatever valid segments are on disk
+        self._attach_tiers(getattr(st, "warm_segments", None) or None)
         restored_sigs: set[str] = set()
         missing = 0
         for it in st.corpus_items:
@@ -474,6 +484,30 @@ class Manager:
                  "%s", os.path.basename(st.path), len(self.corpus),
                  len(self.candidates),
                  f", {missing} missing from disk" if missing else "")
+
+    def _attach_tiers(self, refs: "list[dict] | None") -> None:
+        """Tiered corpus attach (config `corpus_tiers`): warm segment
+        log at workdir/warm, eviction-victim demotion fused into the
+        admission tick, contents-only promotion swaps.  `refs` are the
+        v2 snapshot's expected-segment descriptors (None on cold start
+        or a v1 snapshot); a missing/corrupt segment is counted, never
+        fatal — warm rows degrade to cold replay."""
+        if not self.cfg.corpus_tiers or self.tiers is not None:
+            return
+        try:
+            from syzkaller_tpu.corpus import TierManager, WarmStore
+            store = WarmStore(os.path.join(self.cfg.workdir, "warm"),
+                              expect_refs=refs)
+            self.tiers = TierManager(store, telemetry=self.device_stats)
+            self.engine.attach_tiers(self.tiers)
+            if store.corrupt_skipped or store.ref_mismatches:
+                log.logf(0, "warm store mounted with %d corrupt segment(s)"
+                         " skipped, %d snapshot ref(s) missing",
+                         store.corrupt_skipped, store.ref_mismatches)
+        except Exception as e:
+            self.tiers = None
+            log.logf(0, "tiered corpus attach failed (%s); running "
+                     "untiered", e)
 
     # -- autopilot action seams --------------------------------------------
 

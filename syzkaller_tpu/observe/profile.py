@@ -46,7 +46,7 @@ HIST_BASE = 1e-6
 # every jitted closure cover/engine.py:_build() publishes; attach()
 # skips names a particular engine build doesn't have
 DISPATCH_ATTRS = (
-    "_fuzz_tick_fn",
+    "_fuzz_tick_fn", "_evict_scores_fn", "_swap_rows_fn",
     "_synth_fn", "_random_bits_fn", "_ingest_update_fn",
     "_ingest_admit_fn", "_ingest_diff_fn", "_ingest_pack_fn",
     "_ingest_pack_or_fn", "_decision_fn", "_popcount_fn", "_pack_fn",

@@ -392,6 +392,18 @@ print("san smoke ok: armed storm + failover, 0 findings")
         assert top and all(
             set(d) == {"name", "calls", "seconds_sum", "recompiles"}
             for d in top), "malformed dispatch_top table"
+        # tiered-corpus acceptance: fuzzing ≥100x past corpus_cap must
+        # keep the recency-skewed working set ≥90% hot-tier resident,
+        # compile NOTHING on the warm promote/demote paths
+        # (contents-only swaps behind fixed dispatch signatures), and
+        # stay frontier bit-exact vs an unbounded-table oracle
+        hr = out["extras"]["tier_hot_hit_rate"]
+        assert hr >= 0.9, \
+            f"hot-tier hit rate {hr} under the 90% working-set gate"
+        assert out["extras"]["tier_recompiles_warm"] == 0, \
+            "tiered corpus promote/demote path recompiled warm"
+        assert out["extras"]["tier_frontier_bit_exact"], \
+            "tiered frontier diverged from the unbounded oracle"
         # syz-san acceptance: the smoke must measure the armed-vs-
         # unarmed fuzz-tick cost so overhead drift is visible per run
         # (tiny CPU shapes are noisy, so only sanity-bound it)

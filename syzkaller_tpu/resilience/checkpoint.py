@@ -19,6 +19,17 @@ touched), the corpus signal matrix as COO, and per-campaign frontier
 views as their touched-block sets.  A corrupt or truncated snapshot
 fails checksum/parse and is skipped (counted), falling back to the
 next-newest file and ultimately to the cold full-corpus replay.
+
+Version 2 adds the tiered-corpus state: the hot tables' admit-recency
+vector (`corpus_seen` + the engine tick it is relative to) and the
+warm tier as SEGMENT REFS — {seq, sha256, count} descriptors of the
+WarmStore's on-disk segments, not the segment bytes (the log is its
+own crash-safe store; duplicating megabytes of COO rows into every
+snapshot would defeat both).  On restore the refs pin which segments
+the warm store is EXPECTED to resurface; a missing or corrupt segment
+is skipped-and-counted, never a restore failure.  v1 snapshots still
+load byte-compatibly: the new fields default to "maximally old, no
+warm tier", which is exactly the pre-tier behavior.
 """
 
 from __future__ import annotations
@@ -35,7 +46,10 @@ import numpy as np
 from syzkaller_tpu.utils import fileutil, log
 
 MAGIC = b"SYZSNAP1"
-VERSION = 1
+VERSION = 2
+# every version this decoder still restores; v1 predates the tiered
+# corpus (no corpus_seen / warm segment refs) and loads byte-compatibly
+SUPPORTED_VERSIONS = (1, 2)
 BLOCK_WORDS = 64          # snapshot block granularity (bitmap W is
 #                           64-word aligned by nwords_for)
 
@@ -98,8 +112,9 @@ def decode_snapshot(blob: bytes) -> "tuple[dict, dict]":
     except ValueError as e:
         raise SnapshotError(f"header parse: {e}") from e
     off += hlen
-    if header.get("version") != VERSION:
-        raise SnapshotError(f"version {header.get('version')} != {VERSION}")
+    if header.get("version") not in SUPPORTED_VERSIONS:
+        raise SnapshotError(
+            f"version {header.get('version')} not in {SUPPORTED_VERSIONS}")
     payload = blob[off:]
     if hashlib.sha256(payload).hexdigest() != header.get("sha256"):
         raise SnapshotError("checksum mismatch")
@@ -139,6 +154,16 @@ def collect_snapshot(manager) -> bytes:
                 tsdb_meta, tsdb_arrays = mgr.tsdb.export_state()
             except Exception:
                 tsdb_meta, tsdb_arrays = None, {}
+        # warm tier rides as segment REFS (the mmap'd log is its own
+        # crash-safe store); flushing under the gate makes the refs
+        # consistent with the engine cut above
+        warm_refs = None
+        tiers = getattr(mgr.engine, "tiers", None)
+        if tiers is not None:
+            try:
+                warm_refs = tiers.segment_refs()
+            except Exception:
+                warm_refs = None
 
     arrays = {
         "prios": np.asarray(est["prios"], np.float32),
@@ -149,6 +174,8 @@ def collect_snapshot(manager) -> bytes:
         # bitmap index — without it a restored frontier is gibberish
         "pcmap_keys": mgr.pcmap.export_keys(),
     }
+    if "corpus_seen" in est:
+        arrays["corpus_seen"] = np.asarray(est["corpus_seen"], np.int32)
     for name in ("max_cover", "corpus_cover", "flakes"):
         ids, data = pack_block_sparse(np.asarray(est[name], np.uint32))
         arrays[f"{name}_ids"] = ids
@@ -186,7 +213,10 @@ def collect_snapshot(manager) -> bytes:
                    for cid, title, count in tri_entries],
         "frontier_tags": ftags,
         "shard_layout": shard_layout,
+        "tick": int(est.get("tick", 0)),
     }
+    if warm_refs is not None:
+        meta["warm_segments"] = warm_refs
     if tsdb_meta is not None:
         meta["tsdb"] = tsdb_meta
         arrays.update(tsdb_arrays)
@@ -214,6 +244,13 @@ class RestoredState:
         for name in ("max_cover", "corpus_cover", "flakes"):
             self.engine_state[name] = unpack_block_sparse(
                 arrays[f"{name}_ids"], arrays[f"{name}_data"], R, W)
+        # v2 tiered-corpus state; a v1 snapshot simply lacks both, and
+        # import_state defaults recency to zeros (= maximally old)
+        if "corpus_seen" in arrays:
+            self.engine_state["corpus_seen"] = \
+                np.asarray(arrays["corpus_seen"], np.int32)
+        self.engine_state["tick"] = int(meta.get("tick", 0))
+        self.warm_segments = meta.get("warm_segments") or []
         self.corpus_items = meta.get("corpus_items", [])
         self.campaign = meta.get("campaign") or {}
         self.triage = [(cid, title, int(count))

@@ -87,6 +87,15 @@ SCALAR_SLOTS = [
     # closure also bumps the dense_/admit_/ingest_ slots its unfused
     # halves would have, so those series stay comparable either way)
     ("tick_batches", "syz_fuzz_tick_dispatches_total", {}),
+    # tiered corpus hierarchy: hot-tier (device table) churn against the
+    # warm (mmap'd segment log) tier.  evictions is bumped in-dispatch by
+    # the fused tick; the rest are host-known TierManager counts.
+    ("tier_evictions", "syz_corpus_tier_evictions", {}),
+    ("tier_promotions", "syz_corpus_tier_promotions", {}),
+    ("tier_hot_hits", "syz_corpus_tier_hit", {"tier": "hot"}),
+    ("tier_hot_misses", "syz_corpus_tier_miss", {"tier": "hot"}),
+    ("tier_warm_rows", "syz_corpus_tier_rows", {"tier": "warm"}),
+    ("tier_warm_bytes", "syz_corpus_tier_bytes", {"tier": "warm"}),
 ]
 
 HIST_SLOTS = [

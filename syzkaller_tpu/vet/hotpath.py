@@ -46,6 +46,11 @@ ROOTS: dict[str, set[str]] = {
                         "_write_ring", "call_ids", "exec_bytes"},
     "ipc/ring.py": {"read_batch", "consume", "write", "write_batch"},
     "ipc/env.py": {"exec", "_parse_output"},
+    # warm-tier resolve path: a hot miss costs ONE batched mmap gather
+    # + ONE fixed-shape swap dispatch for the whole batch — per-item
+    # Python iteration here turns every miss into host packing
+    "corpus/tiers.py": {"resolve_rows", "promote"},
+    "corpus/segments.py": {"read_rows"},
 }
 
 MAX_DEPTH = 2
@@ -112,14 +117,17 @@ class _Scanner:
     @staticmethod
     def _const_iter(it: ast.expr) -> bool:
         """True for iteration whose trip count is a source constant —
-        `for _ in range(3)` retry loops and literal-tuple walks don't
-        scale with exec/slab count."""
+        `for _ in range(3)` retry loops, `range(MAX_SEGMENTS)` sweeps
+        over an UPPERCASE module constant, and literal-tuple walks
+        don't scale with exec/slab count."""
         if isinstance(it, (ast.Tuple, ast.Constant)):
             return all(isinstance(e, ast.Constant)
                        for e in getattr(it, "elts", []))
         if isinstance(it, ast.Call) and isinstance(it.func, ast.Name) \
                 and it.func.id == "range":
-            return all(isinstance(a, ast.Constant) for a in it.args)
+            return all(isinstance(a, ast.Constant)
+                       or (isinstance(a, ast.Name) and a.id.isupper())
+                       for a in it.args)
         return False
 
     def _call(self, call: ast.Call, scope: str, depth: int) -> None:

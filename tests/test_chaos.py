@@ -483,6 +483,117 @@ def test_persistent_corrupt_load_counted(tmp_path):
     assert not os.path.exists(os.path.join(d, ".tmp-orphan"))
 
 
+# -- snapshot codec v2: tiered-corpus state ----------------------------------
+
+
+def _reencode_as_v1(path):
+    """Rewrite a v2 snapshot file as a byte-faithful v1: drop the
+    tiered-corpus fields, stamp version 1, re-checksum."""
+    import io
+    import json
+    import struct
+
+    with open(path, "rb") as f:
+        meta, arrays = checkpoint.decode_snapshot(f.read())
+    for k in ("tick", "warm_segments", "version", "sha256"):
+        meta.pop(k, None)
+    arrays.pop("corpus_seen", None)
+    buf = io.BytesIO()
+    np.savez(buf, **arrays)
+    payload = buf.getvalue()
+    meta["version"] = 1
+    meta["sha256"] = hashlib.sha256(payload).hexdigest()
+    hb = json.dumps(meta, sort_keys=True).encode()
+    with open(path, "wb") as f:
+        f.write(checkpoint.MAGIC + struct.pack("<I", len(hb)) + hb
+                + payload)
+
+
+def test_snapshot_codec_versions():
+    """v2 is written, v1 still decodes, the future is rejected."""
+    blob = checkpoint.encode_snapshot({"x": 1}, {"a": np.arange(3)})
+    meta, _ = checkpoint.decode_snapshot(blob)
+    assert meta["version"] == 2
+    assert 1 in checkpoint.SUPPORTED_VERSIONS
+    import json
+    import struct
+    hdr = {"version": 3, "sha256": hashlib.sha256(b"").hexdigest()}
+    hb = json.dumps(hdr).encode()
+    future = checkpoint.MAGIC + struct.pack("<I", len(hb)) + hb
+    with pytest.raises(SnapshotError, match="version"):
+        checkpoint.decode_snapshot(future)
+
+
+def test_v1_snapshot_restores_byte_compatibly(tmp_path, table):
+    """A pre-tier (v1) snapshot restores into the tiered manager: the
+    recency vector defaults to maximally-old zeros, tick to 0, and no
+    warm segments are expected — exactly the pre-tier semantics."""
+    inputs = chaos.synth_inputs(table, 12, seed=3)
+    w = tmp_path / "w"
+    mgr = make_mgr(w, table, corpus_tiers=True)
+    assert mgr.tiers is not None
+    for inp in inputs:
+        chaos._admit_direct(mgr, inp)
+    path = mgr.checkpointer.snapshot_once()
+    assert path is not None
+    stop_mgr(mgr)
+    _reencode_as_v1(path)
+    shutil.rmtree(w / "warm", ignore_errors=True)
+
+    mgr2 = make_mgr(w, table, corpus_tiers=True)
+    assert int(mgr2._f_restore.labels(outcome="snapshot").value) == 1
+    assert len(mgr2.corpus) == 12
+    assert mgr2.engine.tick == 0
+    assert (np.asarray(mgr2.engine.corpus_seen) == 0).all()
+    assert mgr2.tiers is not None
+    assert mgr2.tiers.store.ref_mismatches == 0
+    stop_mgr(mgr2)
+
+
+def test_v2_snapshot_carries_warm_segment_refs(tmp_path, table):
+    """The v2 snapshot names the warm segments as refs; a restore
+    checks them out, and a CORRUPT warm segment is skipped-and-counted
+    — the snapshot restore itself never bricks."""
+    inputs = chaos.synth_inputs(table, 8, seed=7)
+    w = tmp_path / "w"
+    mgr = make_mgr(w, table, corpus_tiers=True)
+    for inp in inputs:
+        chaos._admit_direct(mgr, inp)
+    rng = np.random.default_rng(2)
+    ids = mgr.tiers.store.append_rows(
+        np.zeros(6, np.int64),
+        rng.integers(1, 2 ** 32, (6, 8), dtype=np.uint32),
+        np.zeros(6, np.int64), np.arange(6, dtype=np.int64))
+    path = mgr.checkpointer.snapshot_once()
+    stop_mgr(mgr)
+    with open(path, "rb") as f:
+        meta, arrays = checkpoint.decode_snapshot(f.read())
+    assert meta["version"] == 2
+    assert len(meta["warm_segments"]) >= 1
+    assert "corpus_seen" in arrays
+
+    # clean restore: every ref checks out, warm rows readable
+    mgr2 = make_mgr(w, table, corpus_tiers=True)
+    assert int(mgr2._f_restore.labels(outcome="snapshot").value) == 1
+    assert mgr2.tiers.store.ref_mismatches == 0
+    assert mgr2.tiers.store.known(ids).all()
+    stop_mgr(mgr2)
+
+    # corrupt the warm segment: restore still lands, loss is counted
+    seg = [n for n in os.listdir(w / "warm") if n.endswith(".warm")][0]
+    p = w / "warm" / seg
+    blob = bytearray(p.read_bytes())
+    blob[-3] ^= 0x7F
+    p.write_bytes(bytes(blob))
+    mgr3 = make_mgr(w, table, corpus_tiers=True)
+    assert int(mgr3._f_restore.labels(outcome="snapshot").value) == 1
+    assert len(mgr3.corpus) == 8
+    assert mgr3.tiers is not None
+    assert mgr3.tiers.store.corrupt_skipped == 1
+    assert mgr3.tiers.store.ref_mismatches >= 1
+    stop_mgr(mgr3)
+
+
 # -- the full chaos cycle (real subprocess fleet) ----------------------------
 
 

@@ -1,0 +1,337 @@
+"""Tiered corpus hierarchy tests: hot (fixed-cap device tables) /
+warm (mmap'd segment log) / cold (persistent corpus).
+
+The contract under test, in acceptance order:
+
+  * frontier bit-exactness — a tiered engine running 40x past its
+    corpus_cap produces the SAME max-cover and corpus-cover frontiers
+    and the SAME per-tick admission verdicts as an unbounded-table
+    oracle over the identical stream (eviction moves signal MATRIX
+    rows, never frontier bits);
+  * zero warm recompiles — 1k mixed promote/evict cycles through the
+    resolve path compile nothing (CompileCounter): promotion is a
+    contents-only swap behind one fixed dispatch signature;
+  * crash safety — a SIGKILL at any stage of segment compaction
+    (fault-injection hooks) leaves a chain from which a fresh mount
+    restores every admitted record, and a corrupt segment is
+    skipped-and-counted, never a mount failure.
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+from syzkaller_tpu.corpus import (
+    MAGIC, TierManager, WarmStore, decode_segment, encode_segment)
+from syzkaller_tpu.cover.engine import CoverageEngine
+from syzkaller_tpu.fuzzer.pcmap import DeviceKeyMirror, PcMap
+from syzkaller_tpu.vet.runtime import CompileCounter
+
+B, K = 8, 16
+
+
+def _mk_engine(cap, tmp=None, **kw):
+    eng = CoverageEngine(npcs=1 << 12, ncalls=8, corpus_cap=cap,
+                         batch=B, max_pcs_per_exec=K, **kw)
+    pm = PcMap(1 << 12)
+    mirror = DeviceKeyMirror(pm, put=eng.put_replicated)
+    tm = None
+    if tmp is not None:
+        tm = TierManager(WarmStore(os.path.join(str(tmp), "warm")),
+                         engine=eng)
+    return eng, mirror, tm
+
+
+def _tick_batch(rng, it, dup_from=None):
+    """One batch of B execs, each covering K distinct PCs; `dup_from`
+    replays an earlier iteration's PCs (no new signal — not admitted)."""
+    src = it if dup_from is None else dup_from
+    win = np.zeros((B, K), np.uint32)
+    for i in range(B):
+        base = K * (src * B + i) + 1
+        win[i] = np.arange(base, base + K, dtype=np.uint32)
+    counts = np.full((B,), K, np.int32)
+    cids = rng.integers(0, 8, B).astype(np.int32)
+    return win, counts, cids
+
+
+def _drive(eng, mirror, win, counts, cids):
+    live = np.arange(K)[None, :] < counts[:, None]
+    mirror.ensure(win[live])
+    return eng.fuzz_tick(win, counts, cids,
+                         np.full((4,), -1, np.int32), mirror)
+
+
+# -- warm store unit coverage ------------------------------------------------
+
+
+def test_warm_store_append_read_remount(tmp_path):
+    store = WarmStore(str(tmp_path / "warm"))
+    rng = np.random.default_rng(3)
+    rows = (rng.random((40, 128)) < 0.05).astype(np.uint32) * \
+        rng.integers(1, 2 ** 32, (40, 128), dtype=np.uint32)
+    calls = rng.integers(0, 8, 40).astype(np.int64)
+    ticks = np.arange(40, dtype=np.int64)
+    owners = np.arange(100, 140, dtype=np.int64)
+    ids = store.append_rows(calls, rows, ticks, owners)
+    assert store.known(ids).all()          # pending reads resolve too
+    c, b, p, t, o = store.read_rows(ids, 128)
+    assert (b == rows).all() and (c == calls).all()
+    assert (t == ticks).all() and (o == owners).all()
+    store.flush()
+    refs = store.segment_refs()
+    assert refs and all(r["sha256"] for r in refs)
+    again = WarmStore(str(tmp_path / "warm"), expect_refs=refs)
+    assert again.ref_mismatches == 0 and again.corrupt_skipped == 0
+    _, b2, _, _, _ = again.read_rows(ids, 128)
+    assert (b2 == rows).all()
+    with pytest.raises(KeyError):
+        again.read_rows(np.array([10_000_000]), 128)
+
+
+def test_warm_segment_wire_format(tmp_path):
+    recs = np.zeros((3, 16), np.uint32)
+    recs[:, 0] = 0x53595A43
+    blob = encode_segment(7, recs, 16, supersedes=[3, 4])
+    assert blob[:8] == MAGIC
+    header, back = decode_segment(blob)
+    assert header["seq"] == 7 and header["count"] == 3
+    assert header["supersedes"] == [3, 4]
+    assert (back == recs).all()
+
+
+# -- acceptance: frontier bit-exact vs unbounded oracle ----------------------
+
+
+def test_tiered_frontier_bit_exact_vs_unbounded(tmp_path):
+    """A cap-32 tiered engine fuzzing 40x past its cap keeps frontiers
+    and admission verdicts bit-exact with an unbounded-table oracle
+    over the same stream (fresh + duplicate batches mixed)."""
+    rng_a, rng_b = (np.random.default_rng(17) for _ in range(2))
+    tiered, mir_a, tm = _mk_engine(32, tmp=tmp_path)
+    oracle, mir_b, _ = _mk_engine(4096)
+    fresh = 0
+    for it in range(200):
+        dup = None if it % 5 else max(0, fresh - 2)     # replay churn
+        if dup is None:
+            fresh += 1
+        src = fresh - 1 if dup is None else dup
+        ra = _drive(tiered, mir_a, *_tick_batch(rng_a, it, None
+                                                if dup is None else src))
+        rb = _drive(oracle, mir_b, *_tick_batch(rng_b, it, None
+                                                if dup is None else src))
+        assert np.array_equal(ra.has_new, rb.has_new), it
+        assert ra.fused is not False
+    assert tiered.corpus_len == 32
+    assert oracle.corpus_len > 32 * 4
+    assert tm.stat_evictions == oracle.corpus_len - tiered.corpus_len
+    assert np.array_equal(np.asarray(tiered.max_cover),
+                          np.asarray(oracle.max_cover))
+    assert np.array_equal(np.asarray(tiered.corpus_cover),
+                          np.asarray(oracle.corpus_cover))
+
+
+def test_eviction_prefers_shadowed_then_oldest(tmp_path):
+    """The fused tick's victims follow the kernel's score order:
+    fully-shadowed rows go warm before unique-signal rows."""
+    eng, mirror, tm = _mk_engine(16, tmp=tmp_path)
+    rng = np.random.default_rng(23)
+    for it in range(2):                     # fill the 16 hot rows
+        _drive(eng, mirror, *_tick_batch(rng, it))
+    assert eng.corpus_len == 16
+    scores = eng.evict_scores()
+    assert (scores[:16] >= 0).all()         # live rows score
+    assert eng.cap == 16
+    # every live row here has unique signal → shadowed count 0 → the
+    # score is pure age; rows admitted earlier (older tick) rank higher
+    order = np.argsort(scores[:16], kind="stable")[::-1]
+    assert set(order[:8].tolist()) == set(range(8))
+
+
+# -- acceptance: zero warm recompiles ----------------------------------------
+
+
+def test_thousand_promote_evict_cycles_compile_nothing(tmp_path):
+    eng, mirror, tm = _mk_engine(32, tmp=tmp_path)
+    rng = np.random.default_rng(5)
+    owner = 0
+    for it in range(10):                    # run past cap: warm fills
+        res = _drive(eng, mirror, *_tick_batch(rng, it))
+        tm.set_owners(res.rows, np.arange(owner, owner + len(res.rows),
+                                          dtype=np.int64))
+        owner += len(res.rows)
+    assert tm.store.rows_warm > 0
+    # warm every dispatch signature once (promote batch of 1 + a tick)
+    warm_ids = np.nonzero(tm._loc_kind == 1)[0]
+    tm.resolve_rows(np.asarray([warm_ids[0]], np.int64))
+    _drive(eng, mirror, *_tick_batch(rng, 10))
+    with CompileCounter() as cc:
+        for it in range(1000):
+            warm_now = np.nonzero(tm._loc_kind == 1)[0]
+            take = warm_now[int(rng.integers(0, len(warm_now)))]
+            rows = tm.resolve_rows(np.asarray([take], np.int64))
+            assert rows[0] >= 0
+            if it % 100 == 0:               # interleave fused evictions
+                _drive(eng, mirror, *_tick_batch(rng, 11 + it // 100))
+    assert cc.count == 0, cc.events
+    assert tm.stat_promotions >= 1000
+
+
+def test_resolve_rows_tiers(tmp_path):
+    """Hot hit = index lookup; warm miss = one promote; unknown = -1
+    (cold).  Counters track each."""
+    eng, mirror, tm = _mk_engine(32, tmp=tmp_path)
+    rng = np.random.default_rng(11)
+    owners = []
+    for it in range(8):
+        res = _drive(eng, mirror, *_tick_batch(rng, it))
+        rows = res.rows
+        batch = np.arange(it * B, it * B + len(rows), dtype=np.int64)
+        tm.set_owners(rows, batch)
+        owners.extend(batch.tolist())
+    hot = [o for o in owners if tm._loc_kind[o] == 0][0]
+    warm = [o for o in owners if tm._loc_kind[o] == 1][0]
+    got = tm.resolve_rows(np.asarray([hot, warm, 10_000], np.int64))
+    assert got[0] >= 0 and got[1] >= 0 and got[2] == -1
+    assert tm._loc_kind[warm] == 0          # promoted
+    assert tm.stat_hot_hits >= 1 and tm.stat_hot_misses >= 1
+    snap = tm.snapshot_counters()
+    assert snap["promotions"] == tm.stat_promotions
+    assert snap["rows_warm"] == tm.store.rows_warm
+
+
+# -- crash safety ------------------------------------------------------------
+
+
+def _filled_store(tmp_path, nbatches=6, seg_records=16):
+    store = WarmStore(str(tmp_path / "warm"), seg_records=seg_records)
+    rng = np.random.default_rng(9)
+    all_ids, all_rows = [], []
+    for i in range(nbatches):
+        rows = rng.integers(1, 2 ** 32, (16, 8), dtype=np.uint32)
+        ids = store.append_rows(
+            rng.integers(0, 8, 16).astype(np.int64), rows,
+            np.full(16, i, np.int64),
+            np.arange(i * 16, i * 16 + 16, dtype=np.int64))
+        all_ids.append(ids)
+        all_rows.append(rows)
+    store.flush()
+    return store, np.concatenate(all_ids), np.concatenate(all_rows)
+
+
+@pytest.mark.parametrize("stage", ["pre-write", "post-write",
+                                   "mid-unlink"])
+def test_sigkill_mid_compaction_restores_newest_chain(tmp_path, stage):
+    """Kill compaction at every stage: the surviving segment chain
+    restores EVERY admitted record on a fresh mount (zero loss) —
+    before the new segment lands the old chain is intact; after, the
+    superseded files are shadowed-but-harmless until unlinked."""
+    store, ids, rows = _filled_store(tmp_path)
+
+    class Killed(RuntimeError):
+        pass
+
+    def fault(s):
+        if s == stage:
+            raise Killed(s)
+    store._fault = fault
+    with pytest.raises(Killed):
+        store.compact()
+    del store                               # the process is gone
+    again = WarmStore(str(tmp_path / "warm"))
+    assert again.corrupt_skipped == 0
+    assert again.known(ids).all()
+    _, b, _, _, _ = again.read_rows(ids, 8)
+    assert (b == rows).all()
+
+
+def test_corrupt_warm_segment_skipped_and_counted(tmp_path):
+    store, ids, rows = _filled_store(tmp_path)
+    refs = store.segment_refs()
+    names = sorted(n for n in os.listdir(tmp_path / "warm")
+                   if n.endswith(".warm"))
+    # flip payload bytes in the newest segment → checksum fails
+    path = tmp_path / "warm" / names[-1]
+    blob = bytearray(path.read_bytes())
+    blob[-5] ^= 0xFF
+    path.write_bytes(bytes(blob))
+    again = WarmStore(str(tmp_path / "warm"), expect_refs=refs)
+    assert again.corrupt_skipped == 1
+    assert again.ref_mismatches == 1        # the snapshot ref is gone
+    known = again.known(ids)
+    assert known.sum() == len(ids) - 16     # only that segment lost
+    ok = ids[known]
+    _, b, _, _, _ = again.read_rows(ok, 8)
+    assert (b == rows[known]).all()
+
+
+def test_compaction_keeps_newest_per_owner(tmp_path):
+    store = WarmStore(str(tmp_path / "warm"), seg_records=8)
+    rows1 = np.full((4, 4), 1, np.uint32)
+    rows2 = np.full((4, 4), 2, np.uint32)
+    owners = np.arange(4, dtype=np.int64)
+    store.append_rows(np.zeros(4, np.int64), rows1,
+                      np.zeros(4, np.int64), owners)
+    ids2 = store.append_rows(np.zeros(4, np.int64), rows2,
+                             np.ones(4, np.int64), owners)
+    free = store.append_rows(np.zeros(2, np.int64),
+                             np.full((2, 4), 7, np.uint32),
+                             np.zeros(2, np.int64),
+                             np.full(2, -1, np.int64))
+    store.flush()
+    store.compact()
+    # newest generation per owner survives, old one is gone
+    assert store.known(ids2).all() and store.known(free).all()
+    _, b, _, _, o = store.read_rows(ids2, 4)
+    assert (b == rows2).all() and (o == owners).all()
+    assert store.rows_warm == 6
+
+
+# -- fused-tick eviction edge cases ------------------------------------------
+
+
+def test_attach_tiers_requires_headroom(tmp_path):
+    eng = CoverageEngine(npcs=1 << 12, ncalls=8, corpus_cap=8,
+                         batch=8, max_pcs_per_exec=K)
+    with pytest.raises(ValueError, match="2"):
+        eng.attach_tiers(TierManager(WarmStore(str(tmp_path / "w"))))
+
+
+def test_merge_corpus_demotes_when_full(tmp_path):
+    eng, mirror, tm = _mk_engine(16, tmp=tmp_path)
+    rng = np.random.default_rng(31)
+    for it in range(2):
+        _drive(eng, mirror, *_tick_batch(rng, it))
+    assert eng.corpus_len == 16
+    bm = np.zeros((4, eng.W), np.uint32)
+    bm[:, :4] = rng.integers(1, 2 ** 32, (4, 4), dtype=np.uint32)
+    before = tm.stat_evictions
+    rows = eng.merge_corpus(np.zeros(4, np.int64), bm)
+    assert rows is not None and len(rows) == 4
+    assert eng.corpus_len == 16             # cap held, contents swapped
+    assert tm.stat_evictions == before + 4
+    got = np.asarray(eng.corpus_mat)[np.asarray(rows)]
+    assert (got == bm).all()
+
+
+def test_admit_if_new_demotes_when_full(tmp_path):
+    """The serial/coalesced admission gate (`_admit_locked`) with tiers
+    attached: a full matrix demotes instead of dropping — rows come
+    back (the manager's rpc_new_input path keeps growing the device
+    corpus past cap)."""
+    eng, mirror, tm = _mk_engine(16, tmp=tmp_path)
+    rng = np.random.default_rng(33)
+    for it in range(2):
+        _drive(eng, mirror, *_tick_batch(rng, it))
+    assert eng.corpus_len == 16
+    idx = (np.arange(K)[None, :] + 3000).astype(np.int32)   # < npcs, uncovered
+    valid = np.ones_like(idx, bool)
+    before = tm.stat_evictions
+    has_new, rows = eng.admit_if_new(np.array([3], np.int32), idx, valid)
+    assert has_new[0] and rows is not None and len(rows) == 1
+    assert eng.corpus_len == 16             # cap held, contents swapped
+    assert tm.stat_evictions == before + 1
+    # replaying the same cover now rejects: it merged, not dropped
+    has_new, _ = eng.admit_if_new(np.array([3], np.int32), idx, valid)
+    assert not has_new[0]

@@ -27,8 +27,8 @@ def _pallas(name):
 
 
 def test_registry_contract():
-    assert KERNELS.names() == ["signal_diff", "synth_gather",
-                               "translate_slab_rows"]
+    assert KERNELS.names() == ["evict_score", "signal_diff",
+                               "synth_gather", "translate_slab_rows"]
     for name in KERNELS.names():
         spec = KERNELS.spec(name)
         assert spec.oracle.__name__ == name
@@ -74,6 +74,24 @@ def test_signal_diff_parity():
             assert np.array_equal(np.asarray(wn), np.asarray(gn)), (B, W)
             assert np.array_equal(np.asarray(wh), np.asarray(gh)), (B, W)
             assert np.array_equal(np.asarray(wc), np.asarray(gc)), (B, W)
+
+
+def test_evict_score_parity():
+    oracle, pallas = KERNELS.oracle("evict_score"), _pallas("evict_score")
+    rng = np.random.default_rng(7)
+    for C in (8, 64, 256, 1024):
+        for W in (64, 128, 512):
+            mat = rng.integers(0, 2**32, (C, W)).astype(np.uint32)
+            mat[:: 4] = mat[1 :: 4]              # shadowed pairs
+            mat[C // 2] = 0                      # a zero-signal row
+            seen = rng.integers(0, 1000, (C,)).astype(np.int32)
+            for nlive in (0, C // 2, C - 1, C):
+                tick = np.int32(1000)
+                o = np.asarray(oracle(mat, seen, np.int32(nlive), tick))
+                p = np.asarray(pallas(mat, seen, np.int32(nlive), tick))
+                assert np.array_equal(o, p), (C, W, nlive)
+                assert (o[nlive:] == -1).all()
+                assert (o[:nlive] >= 0).all()
 
 
 def test_translate_slab_rows_parity():

@@ -100,7 +100,8 @@ def test_real_engine_specs_cover_donating_closures():
 
     specs = _donation_specs()
     assert specs.get("_update_fn") == (0,)
-    assert specs.get("_fuzz_tick_fn") == (0, 1, 2)
+    assert specs.get("_fuzz_tick_fn") == (0, 1, 2, 18)
+    assert specs.get("_swap_rows_fn") == (0, 1, 2)
     assert all(a.endswith("_fn") for a in specs)
     assert len(specs) >= 10
 
